@@ -1,0 +1,33 @@
+"""ceph_tpu — a TPU-native storage-data-path framework with the capabilities
+of Ceph (reference: RoshanDev/ceph), built from scratch in idiomatic
+JAX/XLA/Pallas plus a C++ host core.
+
+Layer map (mirrors SURVEY.md §1, rebuilt TPU-first):
+
+- ``utils/``     L0 platform primitives: buffers, config, perf counters,
+                 fault injection (ref: src/common/).
+- ``ops/``       device + host math kernels: GF(2^8) Reed-Solomon,
+                 batched CRC32C, CRUSH straw2 (ref: src/erasure-code
+                 jerasure/isa-l math, src/common/crc32c*, src/crush/mapper.c).
+- ``native/``    C++ host core: bit-exact scalar reference implementations
+                 and the CPU baseline (the "jerasure role").
+- ``ec/``        erasure-code codec layer: interface + plugin registry
+                 (ref: src/erasure-code/ErasureCodeInterface.h,
+                 ErasureCodePlugin.cc).
+- ``checksum/``  typed Checksummer (ref: src/common/Checksummer.h).
+- ``placement/`` CRUSH map model + OSDMap epoch pipeline
+                 (ref: src/crush/, src/osd/OSDMap.cc).
+- ``store/``     ObjectStore transactional interface + MemStore
+                 (ref: src/os/ObjectStore.h, src/os/memstore/).
+- ``osd/``       PG-sharded data path: replicated + EC backends, PGLog
+                 (ref: src/osd/).
+- ``cluster/``   control plane: messenger, mon-lite, heartbeats, client
+                 (ref: src/msg/, src/mon/, src/osdc/).
+- ``parallel/``  device-mesh sharding layouts and collective helpers —
+                 the TPU-native replacement for the reference's
+                 NCCL-style/messenger data plane.
+- ``models/``    end-to-end pipelines ("flagship models"): the batched
+                 EC+checksum data-path step and the placement simulator.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,8 @@
+"""Math kernels: GF(2^8) / Reed-Solomon, CRC32C, CRUSH straw2.
+
+Each kernel ships in (up to) three forms:
+- a numpy scalar/batch reference (``*_np``) used by tests,
+- a JAX/XLA device kernel (``*_jax``) — the TPU production path,
+- a C++ native implementation in ``ceph_tpu.native`` — the host
+  baseline and bit-exactness oracle.
+"""

@@ -1,0 +1,199 @@
+"""GF(2^8) arithmetic and Reed-Solomon matrix construction (host side).
+
+The field is GF(2^8) with primitive polynomial x^8+x^4+x^3+x^2+1 (0x11D),
+the polynomial used by the reference's math submodules (gf-complete w=8 and
+ISA-L; see SURVEY.md §2.1 — the submodules are vendored out of tree, so the
+bit-exactness oracle for this build is ceph_tpu.native, which uses the same
+polynomial).
+
+Everything here is tiny host-side math: tables, matrix construction, and
+matrix inversion for decode. The bulk data path lives in ops/rs.py (JAX)
+and native/ (C++).
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+GF_POLY = 0x11D
+GF_ORDER = 256
+
+
+@functools.lru_cache(maxsize=None)
+def _tables() -> tuple[np.ndarray, np.ndarray]:
+    """(exp, log) tables. exp is length 512 so exp[log a + log b] works."""
+    exp = np.zeros(512, dtype=np.uint8)
+    log = np.zeros(256, dtype=np.int32)
+    x = 1
+    for i in range(255):
+        exp[i] = x
+        log[x] = i
+        x <<= 1
+        if x & 0x100:
+            x ^= GF_POLY
+    for i in range(255, 512):
+        exp[i] = exp[i - 255]
+    return exp, log
+
+
+def gf_mul(a: int, b: int) -> int:
+    if a == 0 or b == 0:
+        return 0
+    exp, log = _tables()
+    return int(exp[log[a] + log[b]])
+
+
+def gf_inv(a: int) -> int:
+    if a == 0:
+        raise ZeroDivisionError("gf_inv(0)")
+    exp, log = _tables()
+    return int(exp[255 - log[a]])
+
+
+def gf_div(a: int, b: int) -> int:
+    return gf_mul(a, gf_inv(b))
+
+
+def gf_pow(a: int, n: int) -> int:
+    if n == 0:
+        return 1
+    if a == 0:
+        return 0
+    exp, log = _tables()
+    return int(exp[(log[a] * n) % 255])
+
+
+@functools.lru_cache(maxsize=None)
+def mul_table() -> np.ndarray:
+    """Full 256x256 GF(2^8) multiplication table (numpy reference path)."""
+    exp, log = _tables()
+    a = np.arange(256)
+    t = exp[(log[a][:, None] + log[a][None, :])]
+    t[0, :] = 0
+    t[:, 0] = 0
+    return t.astype(np.uint8)
+
+
+def gf_matmul(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """GF(2^8) matrix product (small host matrices, uint8)."""
+    t = mul_table()
+    # products[i,j,l] = a[i,l] * b[l,j]
+    prod = t[a[:, None, :], b.T[None, :, :]]
+    out = np.zeros((a.shape[0], b.shape[1]), dtype=np.uint8)
+    for l in range(a.shape[1]):
+        out ^= prod[:, :, l]
+    return out
+
+
+def gf_mat_inv(m: np.ndarray) -> np.ndarray:
+    """Invert a square GF(2^8) matrix by Gauss-Jordan elimination.
+
+    Decode-path analog of the reference's per-erasure-pattern matrix
+    inversion (ErasureCodeIsa.cc:302, jerasure_matrix_decode) — tiny k x k,
+    always done on host.
+    """
+    n = m.shape[0]
+    aug = np.concatenate([m.astype(np.uint8), np.eye(n, dtype=np.uint8)], axis=1)
+    t = mul_table()
+    for col in range(n):
+        pivot = None
+        for row in range(col, n):
+            if aug[row, col]:
+                pivot = row
+                break
+        if pivot is None:
+            raise np.linalg.LinAlgError("singular GF(2^8) matrix")
+        if pivot != col:
+            aug[[col, pivot]] = aug[[pivot, col]]
+        inv = gf_inv(int(aug[col, col]))
+        aug[col] = t[inv, aug[col]]
+        for row in range(n):
+            if row != col and aug[row, col]:
+                aug[row] ^= t[int(aug[row, col]), aug[col]]
+    return aug[:, n:].copy()
+
+
+def vandermonde_rs_matrix(k: int, m: int) -> np.ndarray:
+    """Systematic Reed-Solomon coding matrix, Vandermonde construction.
+
+    Mirrors the role of jerasure's reed_sol_vandermonde_coding_matrix used
+    by the reference's default EC technique ("reed_sol_van",
+    ErasureCodeJerasure.cc:105-162): build the (k+m) x k extended
+    Vandermonde matrix V[i][j] = i^j, reduce so the top k x k block is the
+    identity via elementary column operations, and return the bottom m rows.
+    Any k rows of the resulting (k+m) x k generator are linearly
+    independent, which is the MDS property decode relies on.
+    """
+    if k + m > GF_ORDER:
+        raise ValueError(f"k+m={k + m} exceeds field order {GF_ORDER}")
+    rows = k + m
+    v = np.zeros((rows, k), dtype=np.uint8)
+    for i in range(rows):
+        for j in range(k):
+            v[i, j] = gf_pow(i, j)
+    # Column-reduce so top k x k becomes identity (operations preserve the
+    # MDS property: column ops are invertible and applied to all rows).
+    for col in range(k):
+        # ensure v[col,col] != 0 by swapping with a later column
+        if v[col, col] == 0:
+            for c2 in range(col + 1, k):
+                if v[col, c2]:
+                    v[:, [col, c2]] = v[:, [c2, col]]
+                    break
+            else:
+                raise np.linalg.LinAlgError("degenerate Vandermonde")
+        inv = gf_inv(int(v[col, col]))
+        t = mul_table()
+        v[:, col] = t[inv, v[:, col]]
+        for c2 in range(k):
+            if c2 != col and v[col, c2]:
+                v[:, c2] ^= t[int(v[col, c2]), v[:, col]]
+    assert (v[:k] == np.eye(k, dtype=np.uint8)).all()
+    return v[k:].copy()
+
+
+def cauchy_rs_matrix(k: int, m: int) -> np.ndarray:
+    """Systematic Cauchy coding matrix: c[i][j] = 1/(x_i + y_j).
+
+    The construction behind the reference's "cauchy_orig"/ISA-L cauchy
+    technique (gf_gen_cauchy1_matrix): x_i = i + k, y_j = j, guaranteed
+    invertible for any square submatrix (Cauchy matrices are totally
+    nonsingular), hence MDS without the Vandermonde reduction step.
+    """
+    if k + m > GF_ORDER:
+        raise ValueError(f"k+m={k + m} exceeds field order {GF_ORDER}")
+    c = np.zeros((m, k), dtype=np.uint8)
+    for i in range(m):
+        for j in range(k):
+            c[i, j] = gf_inv((i + k) ^ j)
+    return c
+
+
+def parity_only_matrix(k: int) -> np.ndarray:
+    """m=1 XOR parity row (RAID5-style; matches RS with m=1)."""
+    return np.ones((1, k), dtype=np.uint8)
+
+
+def decode_matrix(gen: np.ndarray, k: int, present: list[int]) -> np.ndarray:
+    """Build the k x k recovery matrix from k surviving chunk indices.
+
+    ``gen`` is the m x k coding matrix; chunk index i < k is data chunk i
+    (generator row = unit vector e_i), index k+j is parity row j. Rows of
+    the recovery matrix follow the order of ``present`` — the surviving
+    chunks must be stacked in that same order. Returns R such that
+    data = R @ surviving_chunks (GF matmul), i.e. the inverse of the
+    surviving-rows generator submatrix — same contract as
+    minimum_to_decode + decode_chunks in ErasureCodeInterface.h:297,411.
+    """
+    if len(present) != k:
+        raise ValueError(f"need exactly k={k} present chunks, got {len(present)}")
+    if len(set(present)) != k:
+        raise ValueError(f"duplicate chunk indices in present: {present}")
+    sub = np.zeros((k, k), dtype=np.uint8)
+    for r, idx in enumerate(present):
+        if idx < k:
+            sub[r, idx] = 1
+        else:
+            sub[r] = gen[idx - k]
+    return gf_mat_inv(sub)

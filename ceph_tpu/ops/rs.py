@@ -1,0 +1,142 @@
+"""Reed-Solomon GF(2^8) encode/decode as JAX/XLA TPU kernels.
+
+Design (SURVEY.md §7 "Kernel strategy"): a GF(2^8) multiply by a constant
+coefficient c is linear over GF(2), so
+
+    y = mul(c, x) = XOR_{b=0..7} bit_b(x) * mul(c, 1 << b)
+
+With four bytes packed per uint32 lane (SWAR), ``bit_b`` of all four bytes
+is isolated by ``(x >> b) & 0x01010101`` and the per-byte multiply by the
+constant byte ``mc = mul(c, 1<<b) < 256`` is an ordinary integer multiply —
+no cross-byte carries are possible. The whole encode is therefore a fused
+chain of shift/and/mul/xor on uint32 vectors: integer-only, bit-exact by
+construction, no gathers, and entirely in XLA's elementwise-fusion sweet
+spot. This replaces the reference's SIMD GF tables (gf-complete
+"split-table" methods, ISA-L ec_encode_data — ErasureCodeJerasure.cc:105,
+ErasureCodeIsa.cc:120) with the TPU-native equivalent.
+
+Decode = host-side inversion of the surviving-rows generator submatrix
+(ops/gf8.py, mirroring jerasure_matrix_decode/ErasureCodeIsa.cc:302) +
+the same device kernel with the recovery matrix.
+
+Data layout: chunks are uint32 arrays of shape (..., k, W) where W =
+chunk_bytes / 4, little-endian byte packing. The leading batch dims are
+the stripe batch — the axis the data path shards over the device mesh.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import gf8
+
+_LOW_BITS = np.uint32(0x01010101)
+
+
+def _bitplanes(x: jax.Array) -> list[jax.Array]:
+    """Isolate bit b of each packed byte, for b in 0..7."""
+    m = jnp.uint32(_LOW_BITS)
+    return [(jax.lax.shift_right_logical(x, jnp.uint32(b)) & m) for b in range(8)]
+
+
+def gf_matmul_u32(matrix: np.ndarray, chunks: jax.Array) -> jax.Array:
+    """GF(2^8) matrix-vector product over packed byte streams.
+
+    matrix: (R, C) uint8 host constants (coding or recovery matrix).
+    chunks: (..., C, W) uint32. Returns (..., R, W) uint32 where
+    out[r] = XOR_c mul(matrix[r, c], chunks[c]) bytewise.
+
+    The Python loops are static: they unroll into one fused XLA kernel.
+    Bit-planes of each input chunk are computed once and reused across all
+    output rows (the dominant term is then 2 vector ops per (row, chunk,
+    bit) triple).
+    """
+    rows, cols = matrix.shape
+    if chunks.shape[-2] != cols:
+        raise ValueError(f"chunks axis -2 is {chunks.shape[-2]}, matrix wants {cols}")
+    chunks = chunks.astype(jnp.uint32)
+    planes: list[list[jax.Array] | None] = [None] * cols
+    need_planes = [
+        any(matrix[r, c] not in (0, 1) for r in range(rows)) for c in range(cols)
+    ]
+    for c in range(cols):
+        if need_planes[c]:
+            planes[c] = _bitplanes(chunks[..., c, :])
+
+    outs = []
+    for r in range(rows):
+        acc = None
+        for c in range(cols):
+            coeff = int(matrix[r, c])
+            if coeff == 0:
+                continue
+            if coeff == 1:
+                term = chunks[..., c, :]
+            else:
+                term = None
+                for b in range(8):
+                    mc = gf8.gf_mul(coeff, 1 << b)
+                    part = planes[c][b] * jnp.uint32(mc)
+                    term = part if term is None else term ^ part
+            acc = term if acc is None else acc ^ term
+        if acc is None:
+            acc = jnp.zeros(chunks.shape[:-2] + (chunks.shape[-1],), jnp.uint32)
+        outs.append(acc)
+    return jnp.stack(outs, axis=-2)
+
+
+@functools.lru_cache(maxsize=64)
+def _jit_matmul(matrix_bytes: bytes, rows: int, cols: int):
+    matrix = np.frombuffer(matrix_bytes, dtype=np.uint8).reshape(rows, cols)
+    return jax.jit(functools.partial(gf_matmul_u32, matrix))
+
+
+def jit_gf_matmul(matrix: np.ndarray):
+    """Cached jitted GF matmul specialized to a host coding matrix."""
+    m = np.ascontiguousarray(matrix, dtype=np.uint8)
+    return _jit_matmul(m.tobytes(), m.shape[0], m.shape[1])
+
+
+def encode(matrix: np.ndarray, data: jax.Array) -> jax.Array:
+    """Parity chunks for systematic RS: data (..., k, W) -> (..., m, W)."""
+    return jit_gf_matmul(matrix)(data)
+
+
+def decode(
+    matrix: np.ndarray,
+    k: int,
+    present: list[int],
+    chunks: jax.Array,
+) -> jax.Array:
+    """Recover all k data chunks from any k surviving chunks.
+
+    matrix: the m x k coding matrix. present: chunk indices (0..k-1 data,
+    k..k+m-1 parity) of the surviving chunks, in the exact order they are
+    stacked on chunks' axis -2 (any order works). chunks: (..., k, W).
+    Returns data (..., k, W). Mirrors decode_chunks
+    (ErasureCodeInterface.h:411).
+    """
+    r = gf8.decode_matrix(matrix, k, list(present))
+    return jit_gf_matmul(r)(chunks)
+
+
+# -------------------- numpy reference (tests only) --------------------
+
+
+def encode_np(matrix: np.ndarray, data: np.ndarray) -> np.ndarray:
+    """Bytewise numpy reference: data (k, L) uint8 -> (m, L) uint8."""
+    return gf8.gf_matmul(matrix, data)
+
+
+def pack_u32(chunks_bytes: np.ndarray) -> np.ndarray:
+    """(..., L) uint8 with L % 4 == 0 -> (..., L/4) uint32 little-endian."""
+    a = np.ascontiguousarray(chunks_bytes, dtype=np.uint8)
+    return a.view("<u4").reshape(a.shape[:-1] + (a.shape[-1] // 4,))
+
+
+def unpack_u32(words: np.ndarray) -> np.ndarray:
+    a = np.ascontiguousarray(words, dtype="<u4")
+    return a.view(np.uint8).reshape(a.shape[:-1] + (a.shape[-1] * 4,))

@@ -1,0 +1,25 @@
+"""Test harness: force an 8-device virtual CPU mesh (SURVEY.md §7).
+
+Multi-chip hardware is not available in CI; all sharding/collective tests
+run on 8 virtual CPU devices, mirroring how the reference tests cluster
+logic without a cluster (MemStore / vstart tiers, SURVEY.md §4). Bench
+(`bench.py`) runs separately on the real TPU chip.
+
+This must run before jax is imported anywhere in the test process.
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(1234)

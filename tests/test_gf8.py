@@ -1,0 +1,71 @@
+"""GF(2^8) host math: field axioms, matrix construction, inversion."""
+import numpy as np
+import pytest
+
+from ceph_tpu.ops import gf8
+
+
+def test_field_axioms_spot():
+    rng = np.random.default_rng(0)
+    for _ in range(200):
+        a, b, c = (int(x) for x in rng.integers(1, 256, 3))
+        assert gf8.gf_mul(a, b) == gf8.gf_mul(b, a)
+        assert gf8.gf_mul(a, gf8.gf_mul(b, c)) == gf8.gf_mul(gf8.gf_mul(a, b), c)
+        # distributivity over XOR (field addition)
+        assert gf8.gf_mul(a, b ^ c) == gf8.gf_mul(a, b) ^ gf8.gf_mul(a, c)
+        assert gf8.gf_mul(a, gf8.gf_inv(a)) == 1
+
+
+def test_exp_log_roundtrip():
+    exp, log = gf8._tables()
+    for v in range(1, 256):
+        assert exp[log[v]] == v
+    # primitive element generates the full multiplicative group
+    assert len(set(exp[:255].tolist())) == 255
+
+
+def test_mul_table_matches_scalar():
+    t = gf8.mul_table()
+    rng = np.random.default_rng(1)
+    for _ in range(100):
+        a, b = (int(x) for x in rng.integers(0, 256, 2))
+        assert t[a, b] == gf8.gf_mul(a, b)
+
+
+@pytest.mark.parametrize("k,m", [(2, 1), (3, 2), (4, 2), (8, 3), (6, 3), (10, 4)])
+def test_vandermonde_systematic_mds(k, m):
+    gen = gf8.vandermonde_rs_matrix(k, m)
+    assert gen.shape == (m, k)
+    # MDS: every square submatrix formed by choosing any k rows of
+    # [I; gen] must be invertible -> decode matrix exists for every
+    # erasure pattern of size <= m.
+    import itertools
+
+    for present in itertools.combinations(range(k + m), k):
+        r = gf8.decode_matrix(gen, k, list(present))
+        # verify R actually inverts the submatrix
+        sub = np.zeros((k, k), dtype=np.uint8)
+        for row, idx in enumerate(sorted(present)):
+            sub[row] = (np.eye(k, dtype=np.uint8)[idx] if idx < k else gen[idx - k])
+        assert (gf8.gf_matmul(r, sub) == np.eye(k, dtype=np.uint8)).all()
+
+
+@pytest.mark.parametrize("k,m", [(4, 2), (8, 3)])
+def test_cauchy_mds(k, m):
+    import itertools
+
+    gen = gf8.cauchy_rs_matrix(k, m)
+    for present in itertools.combinations(range(k + m), k):
+        gf8.decode_matrix(gen, k, list(present))  # raises if singular
+
+
+def test_matrix_inverse_random(rng):
+    for n in (1, 2, 5, 8):
+        while True:
+            m = rng.integers(0, 256, (n, n)).astype(np.uint8)
+            try:
+                inv = gf8.gf_mat_inv(m)
+                break
+            except np.linalg.LinAlgError:
+                continue
+        assert (gf8.gf_matmul(inv, m) == np.eye(n, dtype=np.uint8)).all()
