@@ -190,6 +190,9 @@ def decode_matrix(gen: np.ndarray, k: int, present: list[int]) -> np.ndarray:
         raise ValueError(f"need exactly k={k} present chunks, got {len(present)}")
     if len(set(present)) != k:
         raise ValueError(f"duplicate chunk indices in present: {present}")
+    m = gen.shape[0]
+    if any(idx < 0 or idx >= k + m for idx in present):
+        raise ValueError(f"chunk index out of range [0,{k + m}) in present: {present}")
     sub = np.zeros((k, k), dtype=np.uint8)
     for r, idx in enumerate(present):
         if idx < k:

@@ -88,7 +88,10 @@ def gf_matmul_u32(matrix: np.ndarray, chunks: jax.Array) -> jax.Array:
     return jnp.stack(outs, axis=-2)
 
 
-@functools.lru_cache(maxsize=64)
+# Sized above the erasure-pattern count for supported k+m (e.g. C(11,8)=165
+# recovery matrices for k=8,m=3 before present-orderings): evicting a jitted
+# kernel costs a full XLA recompile.
+@functools.lru_cache(maxsize=4096)
 def _jit_matmul(matrix_bytes: bytes, rows: int, cols: int):
     matrix = np.frombuffer(matrix_bytes, dtype=np.uint8).reshape(rows, cols)
     return jax.jit(functools.partial(gf_matmul_u32, matrix))

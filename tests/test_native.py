@@ -1,0 +1,123 @@
+"""C++ native core: GF/RS parity with Python+JAX, CRC vectors, straw2."""
+import numpy as np
+import pytest
+
+from ceph_tpu import native as nt
+from ceph_tpu.ops import gf8, rs
+
+
+def test_gf_mul_parity():
+    rng = np.random.default_rng(3)
+    for _ in range(300):
+        a, b = (int(v) for v in rng.integers(0, 256, 2))
+        assert nt.gf_mul(a, b) == gf8.gf_mul(a, b)
+
+
+@pytest.mark.parametrize("k,m", [(2, 1), (4, 2), (8, 3), (10, 4)])
+def test_matrix_parity(k, m):
+    assert (nt.rs_matrix_vandermonde(k, m) == gf8.vandermonde_rs_matrix(k, m)).all()
+    assert (nt.rs_matrix_cauchy(k, m) == gf8.cauchy_rs_matrix(k, m)).all()
+
+
+def test_matinv_parity(rng):
+    m = rng.integers(0, 256, (6, 6)).astype(np.uint8)
+    try:
+        want = gf8.gf_mat_inv(m)
+    except np.linalg.LinAlgError:
+        with pytest.raises(np.linalg.LinAlgError):
+            nt.gf_matinv(m)
+        return
+    assert (nt.gf_matinv(m) == want).all()
+
+
+def test_rs_encode_native_vs_jax(rng):
+    k, m, L = 8, 3, 4096
+    gen = nt.rs_matrix_vandermonde(k, m)
+    data = rng.integers(0, 256, (k, L), dtype=np.uint8)
+    native = nt.rs_encode(gen, data)
+    jaxed = rs.unpack_u32(np.asarray(rs.encode(gen, rs.pack_u32(data))))
+    assert (native == jaxed).all()
+    # multithreaded path identical
+    assert (nt.rs_encode(gen, data, threads=4) == native).all()
+
+
+def test_rs_decode_native(rng):
+    k, m, L = 8, 3, 1024
+    gen = nt.rs_matrix_vandermonde(k, m)
+    data = rng.integers(0, 256, (k, L), dtype=np.uint8)
+    parity = nt.rs_encode(gen, data)
+    allc = np.concatenate([data, parity])
+    present = [0, 2, 3, 4, 5, 6, 8, 10]
+    rec = nt.rs_decode(gen, present, allc[present])
+    assert (rec == data).all()
+
+
+def test_crc32c_known_vectors():
+    # standard CRC-32C check value: crc32c("123456789") = 0xE3069283
+    assert nt.crc32c(b"123456789", seed=0xFFFFFFFF) ^ 0xFFFFFFFF == 0xE3069283
+    # incremental == one-shot
+    a = nt.crc32c(b"hello ", seed=0xFFFFFFFF)
+    assert nt.crc32c(b"world", seed=a) == nt.crc32c(b"hello world", seed=0xFFFFFFFF)
+
+
+def test_crc32c_zeros_combine():
+    for n in (0, 1, 7, 8, 9, 63, 4096, 100000):
+        direct = nt.crc32c(np.zeros(n, np.uint8), seed=0xDEADBEEF)
+        fast = nt.crc32c(None, seed=0xDEADBEEF, length=n)
+        assert direct == fast, n
+
+
+def test_crc32c_batch(rng):
+    blobs = rng.integers(0, 256, (64, 4096), dtype=np.uint8)
+    got = nt.crc32c_batch(blobs)
+    for i in range(64):
+        assert got[i] == nt.crc32c(blobs[i])
+    assert (nt.crc32c_batch(blobs, threads=4) == got).all()
+
+
+def test_crc32c_hw_sw_agree(rng):
+    data = rng.integers(0, 256, 100001, dtype=np.uint8)
+    assert nt.crc32c(data, seed=123) == nt.lib().ct_crc32c_sw(123, data, data.size)
+
+
+def test_xxhash_vectors():
+    assert nt.xxhash32(b"") == 0x02CC5D05
+    assert nt.xxhash32(b"abc") == 0x32D153FF
+    assert nt.xxhash64(b"") == 0xEF46DB3751D8E999
+    assert nt.xxhash64(b"abc") == 0x44BC2CF5AD770999
+
+
+def test_straw2_weight_proportionality():
+    # straw2's contract: selection probability proportional to weight
+    # (mapper.c:339 straw2 exponential-minimum argument)
+    items = np.arange(4, dtype=np.int32)
+    w = np.array([1, 2, 3, 2], dtype=np.uint32) * 0x10000  # 16.16 fixed point
+    xs = np.arange(200000, dtype=np.uint32)
+    out = nt.straw2_bulk(items, w, xs, r=0)
+    counts = np.bincount(out, minlength=4).astype(float)
+    frac = counts / counts.sum()
+    want = w / w.sum()
+    assert np.abs(frac - want).max() < 0.01
+
+
+def test_straw2_zero_weight_never_chosen():
+    items = np.arange(3, dtype=np.int32)
+    w = np.array([0x10000, 0, 0x10000], dtype=np.uint32)
+    out = nt.straw2_bulk(items, w, np.arange(5000, dtype=np.uint32))
+    assert 1 not in set(out.tolist())
+
+
+def test_straw2_stability_under_weight_change():
+    # straw2's headline property vs straw: changing one item's weight only
+    # moves inputs to/from that item, never between unchanged items.
+    items = np.arange(5, dtype=np.int32)
+    w1 = np.array([3, 3, 3, 3, 3], dtype=np.uint32) * 0x10000
+    w2 = w1.copy()
+    w2[2] = 1 * 0x10000  # shrink item 2
+    xs = np.arange(50000, dtype=np.uint32)
+    a = nt.straw2_bulk(items, w1, xs)
+    b = nt.straw2_bulk(items, w2, xs)
+    moved = a != b
+    # every change must involve item 2 (losing an input it used to win)
+    assert ((a[moved] == 2) | (b[moved] == 2)).all()
+    assert (a[moved] == 2).sum() > 0 and (b[moved] == 2).sum() == 0
