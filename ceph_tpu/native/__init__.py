@@ -25,7 +25,12 @@ def _build() -> None:
     srcs = [_DIR / "ct_native.cc", _DIR / "gen_tables.py", _DIR / "Makefile"]
     if _SO.exists() and all(_SO.stat().st_mtime >= s.stat().st_mtime for s in srcs):
         return
-    subprocess.run(["make", "-C", str(_DIR)], check=True, capture_output=True)
+    try:
+        subprocess.run(["make", "-C", str(_DIR)], check=True, capture_output=True)
+    except subprocess.CalledProcessError as e:
+        raise RuntimeError(
+            f"building libceph_tpu_native failed:\n{e.stderr.decode(errors='replace')}"
+        ) from e
 
 
 def _load() -> ctypes.CDLL:
@@ -135,7 +140,8 @@ def rs_matmul(matrix: np.ndarray, data: np.ndarray, threads: int = 0) -> np.ndar
     matrix = np.ascontiguousarray(matrix, dtype=np.uint8)
     data = np.ascontiguousarray(data, dtype=np.uint8)
     rows, k = matrix.shape
-    assert data.shape[0] == k
+    if data.shape[0] != k:
+        raise ValueError(f"data has {data.shape[0]} chunks, matrix wants {k}")
     out = np.empty((rows, data.shape[1]), dtype=np.uint8)
     if threads > 1:
         lib().ct_rs_matmul_mt(matrix, rows, k, data, data.shape[1], out, threads)
@@ -154,7 +160,11 @@ def rs_decode(
     matrix = np.ascontiguousarray(matrix, dtype=np.uint8)
     m, k = matrix.shape
     chunks = np.ascontiguousarray(chunks, dtype=np.uint8)
-    assert chunks.shape[0] == k, "pass exactly k surviving chunks"
+    if chunks.shape[0] != k or len(present) != k:
+        raise ValueError(
+            f"need exactly k={k} surviving chunks, got {chunks.shape[0]} "
+            f"chunks / {len(present)} indices"
+        )
     pres = np.asarray(present, dtype=np.int32)
     out = np.empty((k, chunks.shape[1]), dtype=np.uint8)
     if lib().ct_rs_decode(matrix, k, m, pres, chunks, chunks.shape[1], out) != 0:

@@ -16,6 +16,7 @@
 #include <stddef.h>
 #include <string.h>
 
+#include <mutex>
 #include <thread>
 #include <vector>
 
@@ -33,10 +34,9 @@ static const uint32_t GF_POLY = 0x11d;
 static uint8_t gf_exp[512];
 static uint8_t gf_log[256];
 static uint8_t gf_mul_tbl[256][256];
-static bool gf_ready = false;
+static std::once_flag gf_once;
 
-static void gf_init() {
-  if (gf_ready) return;
+static void gf_init_impl() {
   uint32_t x = 1;
   for (int i = 0; i < 255; i++) {
     gf_exp[i] = (uint8_t)x;
@@ -48,8 +48,9 @@ static void gf_init() {
   for (int a = 1; a < 256; a++)
     for (int b = 1; b < 256; b++)
       gf_mul_tbl[a][b] = gf_exp[gf_log[a] + gf_log[b]];
-  gf_ready = true;
 }
+
+static void gf_init() { std::call_once(gf_once, gf_init_impl); }
 
 uint8_t ct_gf_mul(uint8_t a, uint8_t b) {
   gf_init();
@@ -291,10 +292,9 @@ int ct_rs_decode(const uint8_t* matrix, int k, int m, const int* present,
 // (callers pass seed -1), and data == NULL computes the CRC of `len`
 // zero bytes via the linear shift operator (ceph_crc32c_zeros role).
 static uint32_t crc_tbl[8][256];
-static bool crc_ready = false;
+static std::once_flag crc_once;
 
-static void crc_init() {
-  if (crc_ready) return;
+static void crc_init_impl() {
   for (uint32_t i = 0; i < 256; i++) {
     uint32_t c = i;
     for (int j = 0; j < 8; j++) c = (c >> 1) ^ (0x82F63B78u & (0u - (c & 1)));
@@ -303,8 +303,9 @@ static void crc_init() {
   for (uint32_t i = 0; i < 256; i++)
     for (int t = 1; t < 8; t++)
       crc_tbl[t][i] = (crc_tbl[t - 1][i] >> 8) ^ crc_tbl[0][crc_tbl[t - 1][i] & 0xff];
-  crc_ready = true;
 }
+
+static void crc_init() { std::call_once(crc_once, crc_init_impl); }
 
 static uint32_t crc32c_sw(uint32_t crc, const uint8_t* p, size_t len) {
   crc_init();
@@ -461,8 +462,10 @@ uint32_t ct_crush_hash32_3(uint32_t a, uint32_t b, uint32_t c) {
 }
 
 // 2^44 * log2(x+1), 16.44 fixed point (reference src/crush/mapper.c:226).
+// Domain is 16 bits: straw2 always feeds hash & 0xffff; mask here so the
+// public binding can't index past the tables.
 uint64_t ct_crush_ln(uint32_t xin) {
-  uint32_t x = xin + 1;
+  uint32_t x = (xin & 0xffff) + 1;
   int iexpon = 15;
   if (!(x & 0x18000)) {
     int bits = __builtin_clz(x & 0x1FFFF) - 16;

@@ -1,0 +1,200 @@
+"""Batched CRC32C (Castagnoli) as a JAX/XLA TPU kernel.
+
+The reference computes CRC32C with per-arch asm (src/common/crc32c.cc:17-53
+runtime dispatch; SSE4.2+PCLMUL, ARMv8 CRC, Power8). A TPU has no CRC
+instruction and gathers are slow, so this kernel uses the linearity of CRC
+over GF(2) instead (the same algebra behind the reference's
+ceph_crc32c_zeros combine trick):
+
+- the contribution of one little-endian uint32 word processed from state 0
+  is a GF(2)-linear map of the word: ``c0(w) = XOR_{b set in w} A_b``
+  with 32 constant columns A_b;
+- CRCs of adjacent segments combine as ``crc(L||R) = Z_{|R|}(crc(L)) ^
+  crc(R)`` where Z_n (append n zero bytes) is a constant 32x32 GF(2)
+  matrix — constant *per tree level* when all segments at that level have
+  equal length.
+
+So the whole blob reduces as: per-word columns fold, then a log2(W)-level
+pairwise tree of constant-matrix-apply + XOR. Everything is shift/and/
+multiply/xor on uint32 lanes — no gathers, no sequential scan, bit-exact
+by construction, and embarrassingly batched over blobs (the BlueStore
+checksum-pipeline shape: N x 64 KiB, bluestore_blob_t::calc_csum,
+reference src/os/bluestore/bluestore_types.cc:737).
+
+Seeds fold in host-side: crc(seed, blob) = Z_{len}(seed) ^ crc0(blob).
+Leading zero bytes are no-ops from state 0, so blobs are *front*-padded
+to a power-of-two word count without changing the CRC.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+CRC_POLY_REFLECTED = 0x82F63B78
+
+
+@functools.lru_cache(maxsize=None)
+def _table() -> np.ndarray:
+    t = np.zeros(256, dtype=np.uint64)
+    for i in range(256):
+        c = i
+        for _ in range(8):
+            c = (c >> 1) ^ (CRC_POLY_REFLECTED if c & 1 else 0)
+        t[i] = c
+    return t.astype(np.uint32)
+
+
+def crc32c_np(data, seed: int = 0xFFFFFFFF) -> int:
+    """Scalar numpy/python reference (tests + host-side small inputs)."""
+    t = _table()
+    crc = seed & 0xFFFFFFFF
+    for b in np.frombuffer(bytes(data), dtype=np.uint8):
+        crc = (crc >> 8) ^ int(t[(crc ^ int(b)) & 0xFF])
+    return crc
+
+
+def _zeros_op_columns(nbytes: int) -> np.ndarray:
+    """Columns of the GF(2) operator 'append nbytes zero bytes'."""
+    t = _table()
+    cols = np.zeros(32, dtype=np.uint64)
+    for b in range(32):
+        crc = 1 << b
+        for _ in range(nbytes):
+            crc = (crc >> 8) ^ int(t[crc & 0xFF])
+        cols[b] = crc
+    return cols.astype(np.uint32)
+
+
+def _compose(outer: np.ndarray, inner: np.ndarray) -> np.ndarray:
+    """Column representation of outer∘inner over GF(2)."""
+    out = np.zeros(32, dtype=np.uint64)
+    for b in range(32):
+        v = int(inner[b])
+        acc = 0
+        for j in range(32):
+            if (v >> j) & 1:
+                acc ^= int(outer[j])
+        out[b] = acc
+    return out.astype(np.uint32)
+
+
+@functools.lru_cache(maxsize=None)
+def _word_columns() -> np.ndarray:
+    """A_b = crc (seed 0) of the 4-byte LE word with only bit b set."""
+    t = _table()
+    cols = np.zeros(32, dtype=np.uint64)
+    for b in range(32):
+        word = 1 << b
+        crc = 0
+        for byte_i in range(4):
+            byte = (word >> (8 * byte_i)) & 0xFF
+            crc = (crc >> 8) ^ int(t[(crc ^ byte) & 0xFF])
+        cols[b] = crc
+    return cols.astype(np.uint32)
+
+
+@functools.lru_cache(maxsize=None)
+def _level_columns(level: int) -> np.ndarray:
+    """Z operator for appending 4*2^level zero bytes, by repeated squaring."""
+    if level == 0:
+        return _zeros_op_columns(4)
+    prev = _level_columns(level - 1)
+    return _compose(prev, prev)
+
+
+def zeros_shift(crc: int, nbytes: int) -> int:
+    """Host scalar: crc after appending nbytes zero bytes (seed folding)."""
+    t = _table()
+    # apply in log steps using cached level operators where possible
+    result = crc & 0xFFFFFFFF
+    # cheap direct loop is fine for small, matrix for large
+    if nbytes < 256:
+        for _ in range(nbytes):
+            result = (result >> 8) ^ int(t[result & 0xFF])
+        return result
+    cols = _zeros_op_columns(1)
+    ops = cols
+    n = nbytes
+    while n:
+        if n & 1:
+            acc = 0
+            v = result
+            for b in range(32):
+                if (v >> b) & 1:
+                    acc ^= int(ops[b])
+            result = acc
+        n >>= 1
+        if n:
+            ops = _compose(ops, ops)
+    return result
+
+
+def _apply_cols(cols: np.ndarray, x: jax.Array) -> jax.Array:
+    """y = M x over GF(2), M given by 32 uint32 columns; x uint32 lanes."""
+    acc = None
+    for b in range(32):
+        col = int(cols[b])
+        if col == 0:
+            continue
+        bit = jax.lax.shift_right_logical(x, jnp.uint32(b)) & jnp.uint32(1)
+        term = bit * jnp.uint32(col)
+        acc = term if acc is None else acc ^ term
+    if acc is None:
+        acc = jnp.zeros_like(x)
+    return acc
+
+
+def _crc0_words(words: jax.Array) -> jax.Array:
+    """crc (seed 0) of each blob; words (..., W) uint32, W a power of two."""
+    w = words.shape[-1]
+    assert w & (w - 1) == 0, "word count must be a power of two (front-pad)"
+    c = _apply_cols(_word_columns(), words.astype(jnp.uint32))
+    level = 0
+    while c.shape[-1] > 1:
+        left = c[..., 0::2]
+        right = c[..., 1::2]
+        c = _apply_cols(_level_columns(level), left) ^ right
+        level += 1
+    return c[..., 0]
+
+
+@functools.lru_cache(maxsize=32)
+def _jit_crc0(nwords: int):
+    return jax.jit(_crc0_words)
+
+
+def pack_blobs(blobs: np.ndarray) -> np.ndarray:
+    """(..., L) uint8 -> (..., W) uint32 LE with W a power of two.
+
+    Front-pads with zero bytes (CRC-neutral from state 0).
+    """
+    l = blobs.shape[-1]
+    w = max(1, -(-l // 4))
+    wp = 1 << (w - 1).bit_length()
+    pad = wp * 4 - l
+    if pad:
+        blobs = np.concatenate(
+            [np.zeros(blobs.shape[:-1] + (pad,), np.uint8), blobs], axis=-1
+        )
+    a = np.ascontiguousarray(blobs, dtype=np.uint8)
+    return a.view("<u4").reshape(a.shape[:-1] + (wp,))
+
+
+def crc32c_batch(blobs: np.ndarray, seed: int = 0xFFFFFFFF) -> np.ndarray:
+    """Per-blob CRC32C on device: blobs (..., L) uint8 -> (...,) uint32.
+
+    Matches native/ct_crc32c(seed, blob, L) bit-for-bit.
+    """
+    words = pack_blobs(blobs)
+    crc0 = _jit_crc0(words.shape[-1])(words)
+    seed_part = zeros_shift(seed & 0xFFFFFFFF, blobs.shape[-1])
+    return np.asarray(crc0) ^ np.uint32(seed_part)
+
+
+def crc32c_words_device(words: jax.Array, seed_shifted: int) -> jax.Array:
+    """Device-side entry for fused pipelines: pre-packed words + pre-shifted
+    seed constant (zeros_shift(seed, L)). Stays on device, jit-safe."""
+    return _crc0_words(words) ^ jnp.uint32(seed_shifted)

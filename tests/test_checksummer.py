@@ -1,0 +1,65 @@
+"""Checksummer contract (reference src/common/Checksummer.h)."""
+import numpy as np
+import pytest
+
+from ceph_tpu import checksum as ck
+from ceph_tpu import native as nt
+
+
+@pytest.mark.parametrize("alg,size", [
+    ("none", 0), ("xxhash32", 4), ("xxhash64", 8),
+    ("crc32c", 4), ("crc32c_16", 2), ("crc32c_8", 1),
+])
+def test_value_sizes(alg, size):
+    assert ck.csum_value_size(alg) == size
+
+
+@pytest.mark.parametrize("alg", ["crc32c", "crc32c_16", "crc32c_8", "xxhash32", "xxhash64"])
+def test_calculate_and_verify_clean(rng, alg):
+    cs = ck.Checksummer(alg=alg, csum_block_size=4096)
+    data = rng.integers(0, 256, 4096 * 8, dtype=np.uint8)
+    vals = cs.calculate(data)
+    assert vals.shape == (8,)
+    assert cs.verify(data, vals) == (-1, None)
+
+
+def test_verify_detects_bad_block(rng):
+    cs = ck.Checksummer(alg="crc32c", csum_block_size=4096)
+    data = rng.integers(0, 256, 4096 * 8, dtype=np.uint8)
+    vals = cs.calculate(data)
+    corrupted = data.copy()
+    corrupted[4096 * 3 + 17] ^= 0xFF
+    off, bad = cs.verify(corrupted, vals)
+    assert off == 4096 * 3
+    assert bad == cs.calculate(corrupted)[3]
+
+
+def test_device_path_matches_host(rng):
+    data = rng.integers(0, 256, 4096 * 16, dtype=np.uint8)
+    for alg in ("crc32c", "crc32c_16", "crc32c_8"):
+        cs = ck.Checksummer(alg=alg, csum_block_size=4096)
+        assert (cs.calculate(data, device=True) == cs.calculate(data)).all()
+
+
+def test_crc32c_matches_raw_native(rng):
+    cs = ck.Checksummer(alg="crc32c", csum_block_size=512)
+    data = rng.integers(0, 256, 512 * 4, dtype=np.uint8)
+    vals = cs.calculate(data)
+    for i in range(4):
+        assert vals[i] == nt.crc32c(data[512 * i : 512 * (i + 1)])
+
+
+def test_unaligned_length_rejected():
+    cs = ck.Checksummer(alg="crc32c", csum_block_size=4096)
+    with pytest.raises(ValueError, match="not a multiple"):
+        cs.calculate(np.zeros(1000, np.uint8))
+
+
+def test_bad_block_size_rejected():
+    with pytest.raises(ValueError, match="power of two"):
+        ck.Checksummer(alg="crc32c", csum_block_size=3000)
+
+
+def test_unknown_alg_rejected():
+    with pytest.raises(ValueError, match="unknown csum"):
+        ck.Checksummer(alg="md5")
