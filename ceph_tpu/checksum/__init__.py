@@ -58,10 +58,16 @@ class Checksummer:
 
     alg: str = CSUM_CRC32C
     csum_block_size: int = 4096
-    init_value: int = 0xFFFFFFFF  # reference passes -1 (Checksummer.h:203)
+    # Reference default is -1 of the per-alg init_value_t (Checksummer.h:203):
+    # 2^64-1 for xxhash64 (uint64_t), 2^32-1 for everything else.
+    init_value: int | None = None
 
     def __post_init__(self):
         _check_alg(self.alg)
+        if self.init_value is None:
+            self.init_value = (
+                (1 << 64) - 1 if self.alg == CSUM_XXHASH64 else 0xFFFFFFFF
+            )
         bs = self.csum_block_size
         if bs <= 0 or bs & (bs - 1):
             raise ValueError(f"csum_block_size must be a power of two, got {bs}")

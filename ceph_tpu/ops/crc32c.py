@@ -105,31 +105,39 @@ def _level_columns(level: int) -> np.ndarray:
     return _compose(prev, prev)
 
 
-def zeros_shift(crc: int, nbytes: int) -> int:
-    """Host scalar: crc after appending nbytes zero bytes (seed folding)."""
-    t = _table()
-    # apply in log steps using cached level operators where possible
-    result = crc & 0xFFFFFFFF
-    # cheap direct loop is fine for small, matrix for large
-    if nbytes < 256:
-        for _ in range(nbytes):
-            result = (result >> 8) ^ int(t[result & 0xFF])
-        return result
-    cols = _zeros_op_columns(1)
-    ops = cols
+@functools.lru_cache(maxsize=None)
+def _zeros_cols(nbytes: int) -> np.ndarray:
+    """Columns of Z_nbytes by square-and-multiply over Z_1 (powers of one
+    matrix commute, so composition order is free). Cached per length —
+    the hot path calls this once per (blob length) ever."""
+    ops = _zeros_op_columns(1)
+    result: np.ndarray | None = None
     n = nbytes
     while n:
         if n & 1:
-            acc = 0
-            v = result
-            for b in range(32):
-                if (v >> b) & 1:
-                    acc ^= int(ops[b])
-            result = acc
+            result = ops if result is None else _compose(result, ops)
         n >>= 1
         if n:
             ops = _compose(ops, ops)
+    if result is None:  # nbytes == 0: identity
+        result = np.array([1 << b for b in range(32)], dtype=np.uint32)
     return result
+
+
+def zeros_shift(crc: int, nbytes: int) -> int:
+    """Host scalar: crc after appending nbytes zero bytes (seed folding)."""
+    result = crc & 0xFFFFFFFF
+    if nbytes < 256:
+        t = _table()
+        for _ in range(nbytes):
+            result = (result >> 8) ^ int(t[result & 0xFF])
+        return result
+    cols = _zeros_cols(nbytes)
+    acc = 0
+    for b in range(32):
+        if (result >> b) & 1:
+            acc ^= int(cols[b])
+    return acc
 
 
 def _apply_cols(cols: np.ndarray, x: jax.Array) -> jax.Array:
@@ -161,9 +169,8 @@ def _crc0_words(words: jax.Array) -> jax.Array:
     return c[..., 0]
 
 
-@functools.lru_cache(maxsize=32)
-def _jit_crc0(nwords: int):
-    return jax.jit(_crc0_words)
+# One jitted entry; jax.jit's own shape-keyed cache specializes per W.
+_jit_crc0 = jax.jit(_crc0_words)
 
 
 def pack_blobs(blobs: np.ndarray) -> np.ndarray:
@@ -189,7 +196,7 @@ def crc32c_batch(blobs: np.ndarray, seed: int = 0xFFFFFFFF) -> np.ndarray:
     Matches native/ct_crc32c(seed, blob, L) bit-for-bit.
     """
     words = pack_blobs(blobs)
-    crc0 = _jit_crc0(words.shape[-1])(words)
+    crc0 = _jit_crc0(words)
     seed_part = zeros_shift(seed & 0xFFFFFFFF, blobs.shape[-1])
     return np.asarray(crc0) ^ np.uint32(seed_part)
 

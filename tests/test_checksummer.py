@@ -63,3 +63,16 @@ def test_bad_block_size_rejected():
 def test_unknown_alg_rejected():
     with pytest.raises(ValueError, match="unknown csum"):
         ck.Checksummer(alg="md5")
+
+
+def test_xxhash64_default_init_is_64bit(rng):
+    """Reference seeds xxhash64 with -1 as uint64 (Checksummer.h:203):
+    init_value_t is uint64_t, so the default must be 2^64-1, not 2^32-1."""
+    from ceph_tpu import native
+    from ceph_tpu.checksum import Checksummer
+
+    block = rng.integers(0, 256, 4096, dtype=np.uint8)
+    cs = Checksummer(alg="xxhash64", csum_block_size=4096)
+    got = cs.calculate(block)
+    assert int(got[0]) == native.xxhash64(block, seed=(1 << 64) - 1)
+    assert int(got[0]) != native.xxhash64(block, seed=0xFFFFFFFF)

@@ -1,0 +1,75 @@
+"""Bit-exactness of the JAX straw2 kernels vs the C++ host reference.
+
+The native core is the oracle (same role as the reference's C
+src/crush/mapper.c); every device op must match it exactly — placement
+is an interoperability contract, not an approximation.
+"""
+import numpy as np
+import pytest
+
+from ceph_tpu import native
+from ceph_tpu.ops import crush
+
+
+def test_hash32_parity(rng):
+    a = rng.integers(0, 2**32, 4096, dtype=np.uint32)
+    b = rng.integers(0, 2**32, 4096, dtype=np.uint32)
+    c = rng.integers(0, 2**32, 4096, dtype=np.uint32)
+    got2 = np.asarray(crush.hash32_2(a, b))
+    got3 = np.asarray(crush.hash32_3(a, b, c))
+    for i in range(0, 4096, 97):
+        assert int(got2[i]) == native.crush_hash32_2(int(a[i]), int(b[i]))
+        assert int(got3[i]) == native.crush_hash32_3(
+            int(a[i]), int(b[i]), int(c[i])
+        )
+
+
+def test_crush_ln_full_domain():
+    """All 2^16 inputs — the whole domain, no sampling."""
+    u = np.arange(1 << 16, dtype=np.uint32)
+    got = np.asarray(crush.crush_ln(u))
+    want = np.array([native.crush_ln(int(v)) for v in u], dtype=np.int64)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_straw2_draw_parity(rng):
+    x = rng.integers(0, 2**32, 512, dtype=np.uint32)
+    ids = rng.integers(0, 2**32, 512, dtype=np.uint32)
+    r = rng.integers(0, 16, 512, dtype=np.uint32)
+    w = rng.integers(0, 2**20, 512, dtype=np.uint32)
+    w[::17] = 0  # zero-weight items can never win
+    got = np.asarray(crush.straw2_draw(x, ids, r, w))
+    for i in range(512):
+        assert int(got[i]) == native.straw2_draw(
+            int(x[i]), int(ids[i]), int(r[i]), int(w[i])
+        ), (x[i], ids[i], r[i], w[i])
+
+
+@pytest.mark.parametrize("n_items", [1, 7, 64, 1000])
+def test_straw2_bulk_parity(rng, n_items):
+    items = np.arange(n_items, dtype=np.int32)
+    weights = rng.integers(1, 0x40000, n_items, dtype=np.uint32)
+    if n_items > 3:
+        weights[3] = 0
+    xs = rng.integers(0, 2**32, 20_000, dtype=np.uint32)
+    got = crush.straw2_bulk(items, weights, xs, r=2)
+    want = native.straw2_bulk(items, weights, xs, r=2)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_straw2_distribution(rng):
+    """Sanity: selections follow weights (straw2's defining property)."""
+    items = np.arange(4, dtype=np.int32)
+    weights = (np.array([1, 2, 3, 4]) * 0x10000).astype(np.uint32)
+    xs = rng.integers(0, 2**32, 100_000, dtype=np.uint32)
+    got = crush.straw2_bulk(items, weights, xs)
+    counts = np.bincount(got, minlength=4)
+    frac = counts / counts.sum()
+    np.testing.assert_allclose(frac, np.array([1, 2, 3, 4]) / 10, atol=0.01)
+
+
+def test_x64_does_not_leak_default_dtypes():
+    """crush enables jax x64; other kernels pin dtypes explicitly."""
+    import jax.numpy as jnp
+
+    assert jnp.asarray(np.zeros(3, np.uint32)).dtype == jnp.uint32
