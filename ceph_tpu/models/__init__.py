@@ -1,0 +1,8 @@
+"""End-to-end device pipelines ("flagship models").
+
+- ``datapath`` — the batched EC write/repair step: encode + checksum (+
+  placement), single-chip and mesh-sharded. This is the pipeline the
+  OSD-side data path dispatches per stripe batch, and the unit the
+  driver compile-checks (`__graft_entry__.py`).
+"""
+from . import datapath  # noqa: F401

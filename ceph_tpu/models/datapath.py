@@ -1,0 +1,94 @@
+"""The flagship pipeline: batched EC write / repair steps on device.
+
+One "step" is what the EC backend ships to the TPU per stripe batch
+(reference write path: ECBackend::submit_transaction → ECUtil::encode →
+jerasure/ISA-L, then per-chunk CRCs into the shard hinfo —
+ECBackend.cc:1539, ECUtil.cc:123, ECUtil.h hash_info; read-repair path:
+ECUtil::decode, ECBackend.cc:2405). The TPU-native form fuses the GF(2^8)
+matmul with the batched CRC32C tree fold in a single XLA program over a
+(B, k, W) uint32 stripe batch:
+
+    write_step:  data (B, k, W) -> parity (B, m, W), crcs (B, k+m)
+    repair_step: surviving (B, k, W) -> data (B, k, W), crcs (B, k)
+
+Sharding: batches ride the (stripe, width) mesh of ceph_tpu.parallel —
+encode is elementwise over both axes; the CRC tree fold reduces over
+width, which is where XLA inserts the only collectives. The chunk axis is
+deliberately local (see parallel/__init__.py).
+"""
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops import crc32c as crc_ops
+from ..ops import gf8, rs
+
+CRC_SEED = 0xFFFFFFFF
+
+
+@dataclass(frozen=True)
+class ECParams:
+    k: int = 8
+    m: int = 3
+    chunk_bytes: int = 512 * 1024  # 4 MiB stripe / k=8
+    technique: str = "reed_sol_van"
+
+    @property
+    def words(self) -> int:
+        return self.chunk_bytes // 4
+
+    @functools.cached_property
+    def matrix(self) -> np.ndarray:
+        if self.technique == "reed_sol_van":
+            return gf8.vandermonde_rs_matrix(self.k, self.m)
+        if self.technique == "cauchy":
+            return gf8.cauchy_rs_matrix(self.k, self.m)
+        raise ValueError(f"unknown technique {self.technique!r}")
+
+
+def _chunk_crcs(chunks: jax.Array, chunk_bytes: int) -> jax.Array:
+    """Per-chunk CRC32C over the last (word) axis; W must be 2^n."""
+    seed_shifted = crc_ops.zeros_shift(CRC_SEED, chunk_bytes)
+    return crc_ops.crc32c_words_device(chunks, seed_shifted)
+
+
+def write_step(params: ECParams, data: jax.Array):
+    """data (B, k, W) uint32 -> (parity (B, m, W), crcs (B, k+m) uint32).
+
+    crcs cover data chunks then parity chunks, the per-shard hash_info
+    the EC backend persists next to each shard.
+    """
+    parity = rs.gf_matmul_u32(params.matrix, data)
+    chunks = jnp.concatenate([data, parity], axis=-2)
+    return parity, _chunk_crcs(chunks, params.chunk_bytes)
+
+
+def repair_step(params: ECParams, present: tuple[int, ...], surviving: jax.Array):
+    """surviving (B, k, W) uint32 (rows in `present` order) ->
+    (data (B, k, W), crcs (B, k)). The decode matrix is built host-side
+    from the erasure pattern (tiny k x k inversion), the bulk math is the
+    same device kernel as encode."""
+    rmat = gf8.decode_matrix(params.matrix, params.k, list(present))
+    data = rs.gf_matmul_u32(rmat, surviving)
+    return data, _chunk_crcs(data, params.chunk_bytes)
+
+
+@functools.lru_cache(maxsize=64)
+def jit_write_step(params: ECParams):
+    return jax.jit(functools.partial(write_step, params))
+
+
+@functools.lru_cache(maxsize=1024)
+def jit_repair_step(params: ECParams, present: tuple[int, ...]):
+    return jax.jit(functools.partial(repair_step, params, present))
+
+
+def example_batch(params: ECParams, batch: int = 4, seed: int = 0) -> jax.Array:
+    rng = np.random.default_rng(seed)
+    raw = rng.integers(0, 2**32, (batch, params.k, params.words), dtype=np.uint32)
+    return jnp.asarray(raw)

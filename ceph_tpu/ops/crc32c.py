@@ -110,6 +110,7 @@ def _zeros_cols(nbytes: int) -> np.ndarray:
     """Columns of Z_nbytes by square-and-multiply over Z_1 (powers of one
     matrix commute, so composition order is free). Cached per length —
     the hot path calls this once per (blob length) ever."""
+    assert nbytes > 0  # sole caller routes nbytes < 256 to the table loop
     ops = _zeros_op_columns(1)
     result: np.ndarray | None = None
     n = nbytes
@@ -119,8 +120,6 @@ def _zeros_cols(nbytes: int) -> np.ndarray:
         n >>= 1
         if n:
             ops = _compose(ops, ops)
-    if result is None:  # nbytes == 0: identity
-        result = np.array([1 << b for b in range(32)], dtype=np.uint32)
     return result
 
 

@@ -182,9 +182,8 @@ def straw2_choose(
     return items[win]
 
 
-@functools.lru_cache(maxsize=256)
-def _jit_straw2(n: int):
-    return jax.jit(straw2_choose)
+# One jitted entry; jax.jit's shape-keyed cache specializes per (n, N).
+_jit_straw2 = jax.jit(straw2_choose)
 
 
 def straw2_bulk(
@@ -209,7 +208,7 @@ def straw2_bulk(
     weights_d = jnp.asarray(np.ascontiguousarray(weights, dtype=np.uint32))
     xs_d = jnp.asarray(np.ascontiguousarray(xs, dtype=np.uint32))
     with jax.enable_x64():
-        out = _jit_straw2(len(items))(
+        out = _jit_straw2(
             items_d, ids_d, weights_d, xs_d, jnp.asarray(r, dtype=jnp.uint32)
         )
     return np.asarray(out, dtype=np.int32)

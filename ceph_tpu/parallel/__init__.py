@@ -1,0 +1,91 @@
+"""Device-mesh layouts for the TPU-native data path.
+
+The reference scales with processes and sockets (AsyncMessenger fan-out of
+sub-ops to shard OSDs, SURVEY.md §2.5); the TPU build scales with a
+`jax.sharding.Mesh` and lets XLA insert collectives. Two mesh axes cover
+the storage analogs of dp/sp:
+
+- ``stripe`` — the stripe-batch axis (hash-sharding analog: many objects'
+  stripes processed as one batch, one shard of the batch per device).
+- ``width`` — the intra-chunk byte axis (striping / sequence-parallel
+  analog: one chunk's words split across devices, the way
+  Striper::file_to_extents RAID-0s a byte range, osdc/Striper.h:28).
+
+The EC shard axis (k+m chunks) stays *unsharded* on purpose: coding
+chunks are linear combinations of all k data chunks, so sharding it would
+force an all-gather per parity row; keeping it local makes encode purely
+elementwise over (stripe, width) — the layout that rides ICI only where
+reductions genuinely need it (CRC tree folds, scrub digests).
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+STRIPE_AXIS = "stripe"
+WIDTH_AXIS = "width"
+
+
+def get_devices(n: int):
+    """n devices for a mesh: the default backend's if it has enough, else
+    the virtual-CPU backend's (xla_force_host_platform_device_count) —
+    the driver's multi-chip dry-run path on single-chip hosts."""
+    devs = jax.devices()
+    if len(devs) >= n:
+        return devs[:n]
+    try:
+        cpu = jax.devices("cpu")
+    except RuntimeError:
+        cpu = []
+    if len(cpu) >= n:
+        return cpu[:n]
+    raise RuntimeError(
+        f"need {n} devices; have {len(devs)} default + {len(cpu)} cpu"
+    )
+
+
+def make_mesh(devices=None, width: int = 1) -> Mesh:
+    """2D mesh over all (or given) devices: (stripe, width).
+
+    width divides the device count; the remainder goes to the stripe
+    axis. width=1 (default) is the pure batch-parallel layout.
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if n % width:
+        raise ValueError(f"width={width} does not divide device count {n}")
+    arr = np.array(devices).reshape(n // width, width)
+    return Mesh(arr, (STRIPE_AXIS, WIDTH_AXIS))
+
+
+def chunk_batch_spec() -> P:
+    """PartitionSpec for (B, k, W) chunk batches: batch over stripe,
+    chunk axis replicated, words over width."""
+    return P(STRIPE_AXIS, None, WIDTH_AXIS)
+
+
+def chunk_batch_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, chunk_batch_spec())
+
+
+def per_stripe_spec() -> P:
+    """PartitionSpec for per-stripe scalars/ids: (B, ...) over stripe."""
+    return P(STRIPE_AXIS)
+
+
+def per_stripe_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, per_stripe_spec())
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def pad_batch(n: int, mesh: Mesh) -> int:
+    """Smallest batch >= n divisible by the stripe-axis size."""
+    s = mesh.shape[STRIPE_AXIS]
+    return math.ceil(n / s) * s
