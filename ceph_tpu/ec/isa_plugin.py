@@ -1,0 +1,37 @@
+"""ISA-role codec plugin ("isa_tpu").
+
+The reference's isa plugin (ErasureCodeIsa.h:153, ErasureCodeIsa.cc) is
+the same RS math as jerasure behind Intel asm tables, with its own
+technique names (reed_sol_van default, cauchy via gf_gen_cauchy1_matrix)
+and a decode-matrix cache (ErasureCodeIsaTableCache.cc). Here both
+plugins share the GF(2^8) device kernels, so this subclass only maps the
+isa technique names and defaults (k=7, m=3 — ErasureCodeIsa.h) onto the
+shared core; the table-cache role is the lru-cached recovery matrices in
+rs_plugin._decode_matrix_cached.
+"""
+from __future__ import annotations
+
+from . import ECError
+from .registry import register
+from .rs_plugin import RSCodec
+
+
+class IsaCodec(RSCodec):
+    DEFAULT_TECHNIQUE = "reed_sol_van"
+    _TECH_MAP = {"reed_sol_van": "reed_sol_van", "cauchy": "cauchy_orig"}
+
+    def init(self, profile) -> None:
+        profile = dict(profile)
+        technique = profile.get("technique", self.DEFAULT_TECHNIQUE)
+        if technique not in self._TECH_MAP:
+            raise ECError(
+                f"isa technique must be one of {sorted(self._TECH_MAP)}, "
+                f"not {technique!r}"
+            )
+        profile["technique"] = self._TECH_MAP[technique]
+        super().init(profile)
+        self.profile["technique"] = technique  # report the isa-facing name
+
+
+register("isa_tpu", IsaCodec)
+register("isa", IsaCodec)  # reference profile-name compatibility

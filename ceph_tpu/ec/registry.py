@@ -1,0 +1,62 @@
+"""Codec plugin registry (reference: ErasureCodePluginRegistry,
+ErasureCodePlugin.h:45-79 / ErasureCodePlugin.cc:120-180).
+
+The reference dlopens libec_<plugin>.so and calls __erasure_code_init;
+here plugins are Python modules that call ``register(name, factory)`` at
+import. ``preload`` imports the built-in set, mirroring the mon/osd
+"osd_erasure_code_plugins" preload."""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Mapping
+
+_FactoryT = Callable[[], "object"]
+
+
+class PluginRegistry:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._plugins: dict[str, _FactoryT] = {}
+
+    def add(self, name: str, factory: _FactoryT) -> None:
+        with self._lock:
+            if name in self._plugins:
+                raise KeyError(f"EC plugin {name!r} already registered")
+            self._plugins[name] = factory
+
+    def get(self, name: str) -> _FactoryT:
+        with self._lock:
+            try:
+                return self._plugins[name]
+            except KeyError:
+                raise KeyError(
+                    f"unknown EC plugin {name!r}; known: {sorted(self._plugins)}"
+                ) from None
+
+    def names(self) -> list[str]:
+        with self._lock:
+            return sorted(self._plugins)
+
+    def factory(self, profile: Mapping[str, str]):
+        """Instantiate + init a codec from a profile (the
+        ErasureCodePluginRegistry::factory flow)."""
+        plugin = profile.get("plugin", "rs_tpu")
+        codec = self.get(plugin)()
+        codec.init(profile)
+        return codec
+
+
+_instance = PluginRegistry()
+
+
+def instance() -> PluginRegistry:
+    return _instance
+
+
+def register(name: str, factory: _FactoryT) -> None:
+    _instance.add(name, factory)
+
+
+def load_codec(profile: Mapping[str, str]):
+    """Profile -> initialized codec, via the singleton registry."""
+    return _instance.factory(profile)

@@ -170,6 +170,28 @@ def cauchy_rs_matrix(k: int, m: int) -> np.ndarray:
     return c
 
 
+def cauchy_good_matrix(k: int, m: int) -> np.ndarray:
+    """Improved Cauchy matrix ("cauchy_good" role): the cauchy matrix
+    normalized so row 0 and column 0 are all ones. Row/column scaling by
+    nonzero constants preserves the total-nonsingularity (MDS) property;
+    ones mean pure-XOR terms, the same optimization goal as jerasure's
+    cauchy_good technique (fewer GF multiplies per encode)."""
+    c = cauchy_rs_matrix(k, m)
+    t = mul_table()
+    for i in range(m):
+        c[i] = t[gf_inv(int(c[i, 0])), c[i]]
+    for j in range(k):
+        c[:, j] = t[gf_inv(int(c[0, j])), c[:, j]]
+    return c
+
+
+def raid6_matrix(k: int) -> np.ndarray:
+    """RAID6 P+Q rows: P = XOR of data, Q = sum g^j * d_j (m=2,
+    the reed_sol_r6_op construction)."""
+    q = np.array([gf_pow(2, j) for j in range(k)], dtype=np.uint8)
+    return np.stack([np.ones(k, dtype=np.uint8), q])
+
+
 def parity_only_matrix(k: int) -> np.ndarray:
     """m=1 XOR parity row (RAID5-style; matches RS with m=1)."""
     return np.ones((1, k), dtype=np.uint8)

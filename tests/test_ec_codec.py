@@ -1,0 +1,157 @@
+"""Codec layer: interface contracts, techniques, registry, backends.
+
+Models the reference's per-plugin round-trip tests
+(src/test/erasure-code/TestErasureCodeJerasure.cc etc., SURVEY.md §4.1).
+"""
+import numpy as np
+import pytest
+
+from ceph_tpu import ec
+
+TECHS = ["reed_sol_van", "reed_sol_r6_op", "cauchy_orig", "cauchy_good"]
+
+
+def make(plugin="rs_tpu", backend="host", **kw):
+    profile = {"plugin": plugin, "backend": backend}
+    profile.update({k: str(v) for k, v in kw.items()})
+    return ec.load_codec(profile)
+
+
+@pytest.mark.parametrize("technique", TECHS)
+@pytest.mark.parametrize("backend", ["host", "device"])
+def test_roundtrip_all_erasure_pairs(technique, backend, rng):
+    c = make(backend=backend, k=4, m=2, technique=technique)
+    data = rng.integers(0, 256, 4 * 128, dtype=np.uint8).tobytes()
+    n = c.get_chunk_count()
+    encoded = c.encode(range(n), data)
+    assert len(encoded) == n
+    # every 2-erasure pattern must be recoverable
+    for a in range(n):
+        for b in range(a + 1, n):
+            chunks = {i: encoded[i] for i in range(n) if i not in (a, b)}
+            dec = c.decode([a, b], chunks)
+            np.testing.assert_array_equal(dec[a], encoded[a])
+            np.testing.assert_array_equal(dec[b], encoded[b])
+
+
+@pytest.mark.parametrize("backend", ["host", "device"])
+def test_padding_object_not_multiple_of_k(backend, rng):
+    c = make(backend=backend, k=5, m=2)
+    data = rng.integers(0, 256, 1003, dtype=np.uint8).tobytes()
+    cs = c.get_chunk_size(len(data))
+    assert cs * 5 >= 1003 and cs % 4 == 0
+    encoded = c.encode(range(7), data)
+    got = c.decode_concat({i: encoded[i] for i in [0, 2, 3, 4, 6]})
+    np.testing.assert_array_equal(
+        got[:1003], np.frombuffer(data, dtype=np.uint8)
+    )
+    assert (got[1003:] == 0).all()  # zero padding (ErasureCode.cc:169)
+
+
+def test_device_host_parity(rng):
+    data = rng.integers(0, 256, 8 * 4096, dtype=np.uint8).tobytes()
+    for technique in TECHS:
+        h = make(backend="host", k=8, technique=technique)
+        d = make(backend="device", k=8, technique=technique)
+        eh = h.encode(range(h.get_chunk_count()), data)
+        ed = d.encode(range(d.get_chunk_count()), data)
+        for i in eh:
+            np.testing.assert_array_equal(eh[i], ed[i], err_msg=technique)
+
+
+def test_minimum_to_decode():
+    c = make(k=4, m=2)
+    # all wanted available: wanted only
+    assert set(c.minimum_to_decode([0, 1], {0, 1, 2, 3})) == {0, 1}
+    # one missing: need k chunks
+    got = c.minimum_to_decode([0], {1, 2, 3, 4, 5})
+    assert len(got) == 4
+    assert all(v == [(0, 1)] for v in got.values())
+    with pytest.raises(ec.ECError):
+        c.minimum_to_decode([0], {1, 2, 3})
+    # cost-aware: prefer cheap chunks
+    got = c.minimum_to_decode_with_cost([0], {1: 10, 2: 1, 3: 1, 4: 1, 5: 1})
+    assert 1 not in got and len(got) == 4
+
+
+def test_decode_passthrough_and_want_filter(rng):
+    c = make(k=3, m=2)
+    data = rng.integers(0, 256, 300, dtype=np.uint8).tobytes()
+    enc = c.encode([0, 3], data)
+    assert set(enc) == {0, 3}
+    full = c.encode(range(5), data)
+    # passthrough: wanted chunks all present, no decode needed
+    out = c.decode([1, 2], {1: full[1], 2: full[2]})
+    np.testing.assert_array_equal(out[1], full[1])
+
+
+def test_raid6_forces_m2():
+    c = make(technique="reed_sol_r6_op", k=4, m=7)
+    assert c.get_coding_chunk_count() == 2
+    assert c.get_profile()["m"] == "2"
+
+
+def test_chunk_mapping_dd_d():
+    # "DD_D": data chunks land at positions 0,1,3; coding chunk at 2
+    # (ErasureCode::to_mapping, ErasureCode.cc:260-283)
+    c = make(k=3, m=1, mapping="DD_D")
+    assert [c.chunk_index(i) for i in range(4)] == [0, 1, 3, 2]
+
+
+def test_registry():
+    assert "rs_tpu" in ec.instance().names()
+    assert "isa_tpu" in ec.instance().names()
+    with pytest.raises(KeyError):
+        ec.load_codec({"plugin": "nope"})
+    with pytest.raises(ec.ECError):
+        ec.load_codec({"plugin": "rs_tpu", "w": "16"})
+
+
+def test_isa_plugin_technique_names(rng):
+    c = make(plugin="isa_tpu", technique="cauchy", k=4, m=2)
+    assert c.get_profile()["technique"] == "cauchy"
+    data = rng.integers(0, 256, 512, dtype=np.uint8).tobytes()
+    enc = c.encode(range(6), data)
+    dec = c.decode([0, 1], {i: enc[i] for i in [2, 3, 4, 5]})
+    np.testing.assert_array_equal(dec[0], enc[0])
+    with pytest.raises(ec.ECError):
+        make(plugin="isa_tpu", technique="liberation")
+
+
+def test_batched_device_api(rng):
+    from ceph_tpu.ops import rs
+
+    c = make(backend="device", k=4, m=2)
+    data_u8 = rng.integers(0, 256, (16, 4, 256), dtype=np.uint8)
+    packed = rs.pack_u32(data_u8)
+    parity = np.asarray(c.encode_batch(packed))
+    present = (0, 2, 4, 5)
+    surv = np.concatenate([packed[:, [0, 2]], parity], axis=1)
+    dec = np.asarray(c.decode_batch(present, surv))
+    np.testing.assert_array_equal(rs.unpack_u32(dec), data_u8)
+
+
+def test_decode_under_nontrivial_mapping(rng):
+    """Regression: decode must invert chunk_mapping, not treat stored
+    positions as generator indices (review-confirmed corruption bug)."""
+    c = make(k=2, m=2, mapping="D_D_")  # data at 0,2; coding at 1,3
+    data = rng.integers(0, 256, 64, dtype=np.uint8).tobytes()
+    enc = c.encode(range(4), data)
+    half = np.frombuffer(data, np.uint8).reshape(2, -1)
+    np.testing.assert_array_equal(enc[0], half[0])
+    np.testing.assert_array_equal(enc[2], half[1])
+    # lose the second data chunk (position 2): recover from d0 + one parity
+    dec = c.decode([2], {0: enc[0], 1: enc[1]})
+    np.testing.assert_array_equal(dec[2], half[1])
+    # minimum_to_decode answers in position space too
+    need = c.minimum_to_decode([2], {0, 1, 3})
+    assert set(need) <= {0, 1, 3} and len(need) == 2
+
+
+def test_mapping_validation():
+    with pytest.raises(ec.ECError):
+        make(k=3, m=1, mapping="DD")  # too short
+    with pytest.raises(ec.ECError):
+        make(k=3, m=1, mapping="DDDD_")  # wrong length
+    with pytest.raises(ec.ECError):
+        make(k=3, m=1, mapping="DD__")  # wrong D count
