@@ -94,7 +94,10 @@ class ErasureCode:
         """Stored position -> generator index (inverse of chunk_index)."""
         if not self.chunk_mapping:
             return pos
-        return self.chunk_mapping.index(pos)
+        try:
+            return self.chunk_mapping.index(pos)
+        except ValueError:
+            raise ECError(f"chunk position {pos} out of range") from None
 
     # ------------------------------------------------------- minimum sets
 

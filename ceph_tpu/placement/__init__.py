@@ -1,0 +1,16 @@
+"""Placement layer: CRUSH map model, rule engines, OSDMap pipeline.
+
+- ``crushmap`` — map model (buckets/rules/tunables) + the host rule
+  engine, a faithful port of crush_do_rule (reference src/crush/mapper.c:
+  878-1083, choose_firstn :438, choose_indep :633). The host engine is
+  the correctness oracle for the device engine.
+- ``bulk`` — the device rule engine: the same semantics vectorized over
+  large batches of placement inputs with masked fixed-trip iteration
+  (north-star config 5: 10 M objects x 1 K OSDs in one dispatch).
+- ``osdmap`` — epoch-versioned cluster map: pools, OSD states, the
+  object -> PG -> OSD pipeline (reference src/osd/OSDMap.cc:2638-2891),
+  upmap overrides, incrementals.
+"""
+from . import crushmap, osdmap  # noqa: F401
+from .crushmap import CrushMap, Rule, Tunables  # noqa: F401
+from .osdmap import OSDMap, Pool  # noqa: F401

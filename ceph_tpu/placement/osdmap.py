@@ -1,0 +1,291 @@
+"""Epoch-versioned cluster map: the object -> PG -> OSD pipeline.
+
+Mirrors the reference's OSDMap (src/osd/OSDMap.cc): pools with pg/pgp
+counts and masks, per-OSD state (exists/up/in + reweight), CRUSH rule
+dispatch (_pg_to_raw_osds -> crush->do_rule, OSDMap.cc:2638-2650), upmap
+overrides (_apply_upmap :2668), up-set derivation (_raw_to_up_osds
+:2736), primary pick, and incremental epoch advance. The placement seed
+(pps) and stable-mod hashing follow src/include/rados.h and
+OSDMap::pool_raw_pg_to_pps exactly; object names hash with
+ceph_str_hash_rjenkins (src/common/ceph_hash.cc:22).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from .. import native
+from .crushmap import ITEM_NONE, CrushMap
+
+
+def ceph_str_hash_rjenkins(data: bytes) -> int:
+    """Port of ceph_str_hash_rjenkins (ceph_hash.cc:22-98)."""
+    mask = 0xFFFFFFFF
+    a, b, c = 0x9E3779B9, 0x9E3779B9, 0
+    length = len(data)
+    k = 0
+    le = length
+
+    def mix(a: int, b: int, c: int) -> tuple[int, int, int]:
+        a = (a - b - c) & mask
+        a ^= c >> 13
+        b = (b - c - a) & mask
+        b ^= (a << 8) & mask
+        c = (c - a - b) & mask
+        c ^= b >> 13
+        a = (a - b - c) & mask
+        a ^= c >> 12
+        b = (b - c - a) & mask
+        b ^= (a << 16) & mask
+        c = (c - a - b) & mask
+        c ^= b >> 5
+        a = (a - b - c) & mask
+        a ^= c >> 3
+        b = (b - c - a) & mask
+        b ^= (a << 10) & mask
+        c = (c - a - b) & mask
+        c ^= b >> 15
+        return a, b, c
+
+    while le >= 12:
+        a = (a + int.from_bytes(data[k : k + 4], "little")) & mask
+        b = (b + int.from_bytes(data[k + 4 : k + 8], "little")) & mask
+        c = (c + int.from_bytes(data[k + 8 : k + 12], "little")) & mask
+        a, b, c = mix(a, b, c)
+        k += 12
+        le -= 12
+    c = (c + length) & mask
+    tail = data[k:]
+    shifts_c = {11: 24, 10: 16, 9: 8}
+    shifts_b = {8: 24, 7: 16, 6: 8, 5: 0}
+    shifts_a = {4: 24, 3: 16, 2: 8, 1: 0}
+    for i in range(le, 0, -1):
+        byte = tail[i - 1]
+        if i in shifts_c:
+            c = (c + ((byte << shifts_c[i]) & mask)) & mask
+        elif i in shifts_b:
+            b = (b + ((byte << shifts_b[i]) & mask)) & mask
+        else:
+            a = (a + ((byte << shifts_a[i]) & mask)) & mask
+    _, _, c = mix(a, b, c)
+    return c
+
+
+def ceph_stable_mod(x: int, b: int, bmask: int) -> int:
+    """include/rados.h:96 — stable under pg_num growth."""
+    return x & bmask if (x & bmask) < b else x & (bmask >> 1)
+
+
+def calc_bits_of(n: int) -> int:
+    return n.bit_length()
+
+
+@dataclass
+class Pool:
+    id: int
+    name: str
+    size: int = 3
+    min_size: int = 2
+    pg_num: int = 32
+    crush_rule: int = 0
+    type: str = "replicated"  # or "erasure"
+    pgp_num: int = 0
+    ec_profile: dict[str, str] = field(default_factory=dict)
+
+    def __post_init__(self):
+        if self.pgp_num == 0:
+            self.pgp_num = self.pg_num
+
+    @property
+    def pg_num_mask(self) -> int:
+        return (1 << calc_bits_of(self.pg_num - 1)) - 1
+
+    @property
+    def pgp_num_mask(self) -> int:
+        return (1 << calc_bits_of(self.pgp_num - 1)) - 1
+
+    def can_shift_osds(self) -> bool:
+        """Replicated sets compact out holes; EC sets are positional
+        (pg_pool_t::can_shift_osds)."""
+        return self.type == "replicated"
+
+    def raw_pg_to_pg(self, ps: int) -> int:
+        return ceph_stable_mod(ps, self.pg_num, self.pg_num_mask)
+
+    def raw_pg_to_pps(self, ps: int) -> int:
+        """Placement seed (OSDMap::pool_raw_pg_to_pps): re-mod by pgp_num
+        then mix with the pool id so pools don't align."""
+        return native.crush_hash32_2(
+            ceph_stable_mod(ps, self.pgp_num, self.pgp_num_mask), self.id
+        )
+
+
+@dataclass
+class OSDState:
+    exists: bool = True
+    up: bool = True
+    weight: int = 0x10000  # in/out reweight, 16.16 (0 = out, 0x10000 = in)
+
+
+class OSDMap:
+    """The authoritative cluster map (one epoch)."""
+
+    def __init__(self, crush: CrushMap, n_osds: int, epoch: int = 1) -> None:
+        self.epoch = epoch
+        self.crush = crush
+        self.osds: list[OSDState] = [OSDState() for _ in range(n_osds)]
+        self.pools: dict[int, Pool] = {}
+        self.pg_upmap: dict[tuple[int, int], list[int]] = {}
+        self.pg_upmap_items: dict[tuple[int, int], list[tuple[int, int]]] = {}
+        self._out_weights_cache: np.ndarray | None = None
+
+    # ------------------------------------------------------------- state
+
+    @property
+    def n_osds(self) -> int:
+        return len(self.osds)
+
+    def add_pool(self, pool: Pool) -> None:
+        self.pools[pool.id] = pool
+
+    def is_up(self, osd: int) -> bool:
+        return (
+            0 <= osd < len(self.osds)
+            and self.osds[osd].exists
+            and self.osds[osd].up
+        )
+
+    def out_weights(self) -> np.ndarray:
+        """Per-device 16.16 reweight vector; cached until the next
+        incremental (it is read on every placement)."""
+        if self._out_weights_cache is None:
+            w = np.zeros(
+                max(self.crush.max_devices, self.n_osds), dtype=np.uint32
+            )
+            for i, st in enumerate(self.osds):
+                w[i] = st.weight if st.exists else 0
+            self._out_weights_cache = w
+        return self._out_weights_cache
+
+    # ----------------------------------------------------- object -> PG
+
+    def object_to_pg(self, pool_id: int, name: bytes | str) -> tuple[int, int]:
+        """(pool, ps) — the raw pg id for an object name."""
+        if isinstance(name, str):
+            name = name.encode()
+        pool = self.pools[pool_id]
+        ps = pool.raw_pg_to_pg(ceph_str_hash_rjenkins(name))
+        return (pool_id, ps)
+
+    # ------------------------------------------------------- PG -> OSDs
+
+    def pg_to_raw_osds(self, pgid: tuple[int, int]) -> tuple[list[int], int]:
+        """(raw osd vector, pps) — OSDMap::_pg_to_raw_osds."""
+        pool = self.pools[pgid[0]]
+        pps = pool.raw_pg_to_pps(pgid[1])
+        raw = self.crush.do_rule(
+            pool.crush_rule, pps, pool.size, self.out_weights()
+        )
+        return raw, pps
+
+    def _apply_upmap(self, pool: Pool, pgid: tuple[int, int], raw: list[int]):
+        """OSDMap::_apply_upmap (OSDMap.cc:2668): a valid full pg_upmap
+        replaces raw and pg_upmap_items are STILL applied on top; an
+        invalid pg_upmap (any target out/oob) returns raw untouched,
+        skipping items too — matching the reference's early return."""
+        out = list(raw)
+        pm = self.pg_upmap.get(pgid)
+        if pm:
+            for o in pm:
+                if o == ITEM_NONE:
+                    continue
+                if not (0 <= o < self.n_osds) or self.osds[o].weight == 0:
+                    return out  # reject whole override, skip items
+            out = list(pm)
+        for frm, to in self.pg_upmap_items.get(pgid, []):
+            if (
+                not (0 <= to < self.n_osds)
+                or not self.osds[to].exists
+                or self.osds[to].weight == 0
+                or to in out
+            ):
+                continue
+            for i, o in enumerate(out):
+                if o == frm:
+                    out[i] = to
+                    break
+        return out
+
+    def _raw_to_up_osds(self, pool: Pool, raw: list[int]) -> list[int]:
+        """OSDMap.cc:2736: replicated pools compact out down/dne OSDs;
+        EC pools keep positions with NONE holes."""
+        if pool.can_shift_osds():
+            return [o for o in raw if o != ITEM_NONE and self.is_up(o)]
+        return [o if o != ITEM_NONE and self.is_up(o) else ITEM_NONE for o in raw]
+
+    @staticmethod
+    def _pick_primary(osds: list[int]) -> int:
+        for o in osds:
+            if o != ITEM_NONE:
+                return o
+        return -1
+
+    def pg_to_up_acting_osds(
+        self, pgid: tuple[int, int]
+    ) -> tuple[list[int], int]:
+        """(up set, up primary) — the full pipeline of OSDMap.cc:2891
+        (acting == up here until temp mappings land with peering)."""
+        pool = self.pools[pgid[0]]
+        raw, _pps = self.pg_to_raw_osds(pgid)
+        raw = self._apply_upmap(pool, pgid, raw)
+        up = self._raw_to_up_osds(pool, raw)
+        return up, self._pick_primary(up)
+
+    def object_to_up_osds(
+        self, pool_id: int, name: bytes | str
+    ) -> tuple[list[int], int]:
+        return self.pg_to_up_acting_osds(self.object_to_pg(pool_id, name))
+
+    # ------------------------------------------------------ incrementals
+
+    def apply_incremental(self, inc: "Incremental") -> None:
+        if inc.epoch != self.epoch + 1:
+            raise ValueError(
+                f"incremental epoch {inc.epoch} != map epoch {self.epoch}+1"
+            )
+        for osd in inc.down:
+            self.osds[osd].up = False
+        for osd in inc.up:
+            self.osds[osd].up = True
+        for osd, w in inc.weights.items():
+            self.osds[osd].weight = w
+        for pool in inc.new_pools:
+            self.add_pool(pool)
+        for pgid, mapping in inc.new_pg_upmap.items():
+            if mapping:
+                self.pg_upmap[pgid] = mapping
+            else:
+                self.pg_upmap.pop(pgid, None)
+        for pgid, items in inc.new_pg_upmap_items.items():
+            if items:
+                self.pg_upmap_items[pgid] = items
+            else:
+                self.pg_upmap_items.pop(pgid, None)
+        self._out_weights_cache = None
+        self.epoch = inc.epoch
+
+
+@dataclass
+class Incremental:
+    """Delta between epochs (OSDMap::Incremental, applied in order)."""
+
+    epoch: int
+    up: list[int] = field(default_factory=list)
+    down: list[int] = field(default_factory=list)
+    weights: dict[int, int] = field(default_factory=dict)  # osd -> 16.16
+    new_pools: list[Pool] = field(default_factory=list)
+    new_pg_upmap: dict[tuple[int, int], list[int]] = field(default_factory=dict)
+    new_pg_upmap_items: dict[tuple[int, int], list[tuple[int, int]]] = field(
+        default_factory=dict
+    )

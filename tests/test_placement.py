@@ -1,0 +1,443 @@
+"""Placement: host rule engine vs the COMPILED REFERENCE C mapper.
+
+The strongest oracle available: the reference's own mapper.c/hash.c/
+builder.c are compiled into a throwaway shared library in /tmp (nothing
+enters this repo) and every do_rule result is compared bit-for-bit. If
+the reference tree or a C compiler is unavailable the parity tests skip
+and the self-consistency tests still run.
+"""
+from __future__ import annotations
+
+import ctypes
+import subprocess
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from ceph_tpu.placement import crushmap as cm
+from ceph_tpu.placement import osdmap as om
+
+REF = Path("/root/reference/src/crush")
+SHIM_DIR = Path("/tmp/crushref")
+
+_OPS = {
+    cm.OP_TAKE: 1,
+    cm.OP_CHOOSE_FIRSTN: 2,
+    cm.OP_CHOOSE_INDEP: 3,
+    cm.OP_EMIT: 4,
+    cm.OP_CHOOSELEAF_FIRSTN: 6,
+    cm.OP_CHOOSELEAF_INDEP: 7,
+    cm.OP_SET_CHOOSE_TRIES: 8,
+    cm.OP_SET_CHOOSELEAF_TRIES: 9,
+}
+_ALGS = {cm.ALG_UNIFORM: 1, cm.ALG_STRAW2: 5}
+
+_SHIM_SRC = r"""
+/* Flat C API over the reference crush core, for ctypes test oracles. */
+#include "crush/crush.h"
+#include "crush/builder.h"
+#include "crush/mapper.h"
+#include "crush/hash.h"
+#include <stdlib.h>
+
+void* ref_build_map(int n_buckets, const int* bucket_ids,
+                    const int* bucket_types, const int* bucket_algs,
+                    const int* sizes, const int* items_flat,
+                    const int* weights_flat,
+                    int choose_local_tries, int choose_local_fallback_tries,
+                    int choose_total_tries, int chooseleaf_descend_once,
+                    int chooseleaf_vary_r, int chooseleaf_stable) {
+  struct crush_map* map = crush_create();
+  if (!map) return 0;
+  map->choose_local_tries = choose_local_tries;
+  map->choose_local_fallback_tries = choose_local_fallback_tries;
+  map->choose_total_tries = choose_total_tries;
+  map->chooseleaf_descend_once = chooseleaf_descend_once;
+  map->chooseleaf_vary_r = chooseleaf_vary_r;
+  map->chooseleaf_stable = chooseleaf_stable;
+  int off = 0;
+  for (int i = 0; i < n_buckets; i++) {
+    struct crush_bucket* b = crush_make_bucket(
+        map, bucket_algs[i], CRUSH_HASH_RJENKINS1, bucket_types[i],
+        sizes[i], (int*)(items_flat + off), (int*)(weights_flat + off));
+    if (!b) return 0;
+    int id;
+    if (crush_add_bucket(map, bucket_ids[i], b, &id) < 0) return 0;
+    off += sizes[i];
+  }
+  crush_finalize(map);
+  return map;
+}
+
+int ref_add_rule(void* vmap, int ruleno, int n_steps, const int* ops,
+                 const int* arg1, const int* arg2) {
+  struct crush_map* map = vmap;
+  struct crush_rule* rule = crush_make_rule(n_steps, 0);
+  if (!rule) return -1;
+  for (int i = 0; i < n_steps; i++)
+    crush_rule_set_step(rule, i, ops[i], arg1[i], arg2[i]);
+  return crush_add_rule(map, rule, ruleno);
+}
+
+int ref_do_rule(void* vmap, int ruleno, int x, int* result, int result_max,
+                const unsigned* weight, int weight_max) {
+  struct crush_map* map = vmap;
+  char* cwin = malloc(crush_work_size(map, result_max));
+  if (!cwin) return -1;
+  crush_init_workspace(map, cwin);
+  int n = crush_do_rule(map, ruleno, x, result, result_max, weight,
+                        weight_max, cwin, NULL);
+  free(cwin);
+  return n;
+}
+
+void ref_destroy(void* vmap) { crush_destroy((struct crush_map*)vmap); }
+"""
+
+
+def _build_shim() -> Path | None:
+    so = SHIM_DIR / "libcrushshim.so"
+    if so.exists():
+        return so
+    if not REF.exists():
+        return None
+    SHIM_DIR.mkdir(exist_ok=True)
+    (SHIM_DIR / "acconfig.h").write_text(
+        "#define HAVE_SYS_TYPES_H 1\n#define HAVE_STDINT_H 1\n"
+        "#define HAVE_LINUX_TYPES_H 1\n"
+    )
+    (SHIM_DIR / "shim.c").write_text(_SHIM_SRC)
+    srcs = [SHIM_DIR / "shim.c"] + [
+        REF / f for f in ("mapper.c", "hash.c", "crush.c", "builder.c")
+    ]
+    cmd = [
+        "gcc", "-shared", "-fPIC", "-O2",
+        f"-I{SHIM_DIR}", f"-I{REF.parent}", "-o", str(so),
+    ] + [str(s) for s in srcs]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+    except (subprocess.CalledProcessError, FileNotFoundError):
+        return None
+    return so
+
+
+class RefCrush:
+    """ctypes driver for the compiled reference core."""
+
+    def __init__(self, so: Path, m: cm.CrushMap):
+        self.lib = ctypes.CDLL(str(so))
+        self.lib.ref_build_map.restype = ctypes.c_void_p
+        self.lib.ref_build_map.argtypes = [ctypes.c_int] + [
+            ctypes.POINTER(ctypes.c_int)
+        ] * 6 + [ctypes.c_int] * 6
+        self.lib.ref_add_rule.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(ctypes.c_int),
+        ]
+        self.lib.ref_do_rule.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int), ctypes.c_int,
+            ctypes.POINTER(ctypes.c_uint), ctypes.c_int,
+        ]
+        self.lib.ref_destroy.argtypes = [ctypes.c_void_p]
+
+        # buckets must be added parents-last (items must already exist)
+        order = sorted(m.buckets, key=lambda b: -b)
+        ids = (ctypes.c_int * len(order))(*order)
+        types = (ctypes.c_int * len(order))(*[m.buckets[b].type_id for b in order])
+        algs = (ctypes.c_int * len(order))(*[_ALGS[m.buckets[b].alg] for b in order])
+        sizes = (ctypes.c_int * len(order))(*[m.buckets[b].size for b in order])
+        items_flat: list[int] = []
+        weights_flat: list[int] = []
+        for b in order:
+            items_flat += m.buckets[b].items
+            weights_flat += m.buckets[b].weights
+        items = (ctypes.c_int * len(items_flat))(*items_flat)
+        weights = (ctypes.c_int * len(weights_flat))(*weights_flat)
+        t = m.tunables
+        self.map = self.lib.ref_build_map(
+            len(order), ids, types, algs, sizes, items, weights,
+            t.choose_local_tries, t.choose_local_fallback_tries,
+            t.choose_total_tries, t.chooseleaf_descend_once,
+            t.chooseleaf_vary_r, t.chooseleaf_stable,
+        )
+        assert self.map, "reference map build failed"
+        for rid, rule in m.rules.items():
+            ops = (ctypes.c_int * len(rule.steps))(*[_OPS[s.op] for s in rule.steps])
+            a1 = (ctypes.c_int * len(rule.steps))(*[s.arg1 for s in rule.steps])
+            a2 = (ctypes.c_int * len(rule.steps))(*[s.arg2 for s in rule.steps])
+            r = self.lib.ref_add_rule(self.map, rid, len(rule.steps), ops, a1, a2)
+            assert r >= 0
+
+    def do_rule(self, ruleno: int, x: int, numrep: int, weights: np.ndarray):
+        out = (ctypes.c_int * numrep)()
+        w = np.ascontiguousarray(weights, dtype=np.uint32)
+        n = self.lib.ref_do_rule(
+            self.map, ruleno, x, out, numrep,
+            w.ctypes.data_as(ctypes.POINTER(ctypes.c_uint)), len(w),
+        )
+        return [out[i] for i in range(n)]
+
+    def close(self):
+        if self.map:
+            self.lib.ref_destroy(self.map)
+            self.map = None
+
+
+@pytest.fixture(scope="module")
+def shim():
+    so = _build_shim()
+    if so is None:
+        pytest.skip("reference crush core not available to compile")
+    return so
+
+
+def _compare(shim, m: cm.CrushMap, numrep: int, weights=None, n_x=400):
+    if weights is None:
+        weights = np.full(m.max_devices, 0x10000, dtype=np.uint32)
+    ref = RefCrush(shim, m)
+    try:
+        for ruleno in m.rules:
+            for x in range(n_x):
+                got = m.do_rule(ruleno, x, numrep, weights)
+                want = ref.do_rule(ruleno, x, numrep, weights)
+                assert got == want, (
+                    f"rule {ruleno} x={x}: ours {got} != ref {want}"
+                )
+    finally:
+        ref.close()
+
+
+def test_flat_firstn_parity(shim):
+    m = cm.build_flat(12)
+    m.add_rule(cm.flat_firstn_rule(0))
+    _compare(shim, m, numrep=3)
+
+
+def test_flat_weighted_and_reweight_parity(shim, rng):
+    m = cm.build_flat(10, osd_weights=[1, 2, 3, 4, 0.5, 1, 1, 2, 8, 1])
+    m.add_rule(cm.flat_firstn_rule(0))
+    w = np.full(10, 0x10000, dtype=np.uint32)
+    w[2] = 0          # marked fully out
+    w[5] = 0x8000     # half reweighted
+    _compare(shim, m, numrep=4, weights=w)
+
+
+def test_hierarchy_chooseleaf_firstn_parity(shim):
+    m = cm.build_hierarchy(osds_per_host=4, n_hosts=6)
+    m.add_rule(cm.replicated_rule(0, root=-1, failure_domain_type=1))
+    _compare(shim, m, numrep=3)
+
+
+def test_hierarchy_chooseleaf_indep_parity(shim):
+    m = cm.build_hierarchy(osds_per_host=3, n_hosts=8)
+    m.add_rule(cm.ec_rule(0, root=-1, failure_domain_type=1))
+    _compare(shim, m, numrep=6)
+
+
+def test_flat_indep_parity(shim):
+    m = cm.build_flat(14)
+    m.add_rule(cm.ec_rule(0, root=-1, failure_domain_type=0))
+    _compare(shim, m, numrep=11)
+
+
+def test_choose_firstn_host_level_parity(shim):
+    """choose (not chooseleaf) of whole hosts."""
+    m = cm.build_hierarchy(osds_per_host=2, n_hosts=5)
+    m.add_rule(
+        cm.Rule(
+            0,
+            [
+                cm.Step(cm.OP_TAKE, -1),
+                cm.Step(cm.OP_CHOOSE_FIRSTN, 0, 1),
+                cm.Step(cm.OP_EMIT),
+            ],
+        )
+    )
+    _compare(shim, m, numrep=3)
+
+
+def test_legacy_tunables_parity(shim):
+    """vary_r/stable off + local retries on (pre-jewel profiles)."""
+    m = cm.build_hierarchy(osds_per_host=4, n_hosts=5)
+    m.tunables = cm.Tunables(
+        choose_local_tries=2,
+        choose_local_fallback_tries=5,
+        choose_total_tries=19,
+        chooseleaf_descend_once=0,
+        chooseleaf_vary_r=0,
+        chooseleaf_stable=0,
+    )
+    m.add_rule(cm.replicated_rule(0, root=-1, failure_domain_type=1))
+    _compare(shim, m, numrep=3, n_x=200)
+
+
+def test_uniform_bucket_parity(shim):
+    m = cm.CrushMap()
+    m.add_type(1, "root")
+    m.add_bucket(
+        cm.Bucket(
+            id=-1, type_id=1, alg=cm.ALG_UNIFORM,
+            items=list(range(8)), weights=[0x10000] * 8, name="root",
+        )
+    )
+    m.add_rule(cm.flat_firstn_rule(0))
+    _compare(shim, m, numrep=3)
+
+
+def test_deep_hierarchy_parity(shim, rng):
+    """3-level root -> rack -> host -> osd with uneven weights."""
+    m = cm.CrushMap()
+    m.add_type(1, "host")
+    m.add_type(2, "rack")
+    m.add_type(3, "root")
+    osd = 0
+    rack_ids = []
+    bid = -2
+    for r in range(3):
+        host_ids = []
+        for h in range(3):
+            n = int(rng.integers(2, 5))
+            items = list(range(osd, osd + n))
+            osd += n
+            m.add_bucket(
+                cm.Bucket(
+                    id=bid, type_id=1, items=items,
+                    weights=[int(w) for w in rng.integers(0x8000, 0x30000, n)],
+                    name=f"host{r}.{h}",
+                )
+            )
+            host_ids.append(bid)
+            bid -= 1
+        m.add_bucket(
+            cm.Bucket(
+                id=bid, type_id=2, items=host_ids,
+                weights=[m.buckets[h].weight() for h in host_ids],
+                name=f"rack{r}",
+            )
+        )
+        rack_ids.append(bid)
+        bid -= 1
+    m.add_bucket(
+        cm.Bucket(
+            id=bid, type_id=3, items=rack_ids,
+            weights=[m.buckets[r].weight() for r in rack_ids], name="root",
+        )
+    )
+    root = bid
+    m.add_rule(cm.replicated_rule(0, root=root, failure_domain_type=2))
+    m.add_rule(cm.ec_rule(1, root=root, failure_domain_type=1))
+    _compare(shim, m, numrep=3, n_x=300)
+
+
+# ---------------------------------------------------------------- OSDMap
+
+
+def test_object_to_pg_stable_mod():
+    crush = cm.build_flat(4)
+    crush.add_rule(cm.flat_firstn_rule(0))
+    osdm = om.OSDMap(crush, 4)
+    osdm.add_pool(om.Pool(id=1, name="p", pg_num=12))  # non-power-of-two
+    for name in (b"obj1", b"rbd_data.abc", b"x" * 40):
+        _, ps = osdm.object_to_pg(1, name)
+        assert 0 <= ps < 12
+
+
+def test_pg_to_up_acting_replicated_down_filter():
+    crush = cm.build_flat(6)
+    crush.add_rule(cm.flat_firstn_rule(0))
+    osdm = om.OSDMap(crush, 6)
+    osdm.add_pool(om.Pool(id=1, name="p", size=3, pg_num=8))
+    up0, p0 = osdm.pg_to_up_acting_osds((1, 3))
+    assert len(up0) == 3 and p0 == up0[0]
+    # take the primary down: it must vanish from the up set
+    osdm.apply_incremental(om.Incremental(epoch=2, down=[p0]))
+    up1, p1 = osdm.pg_to_up_acting_osds((1, 3))
+    assert p0 not in up1 and p1 != p0
+
+
+def test_pg_to_up_acting_ec_positional_none():
+    crush = cm.build_flat(6)
+    crush.add_rule(cm.ec_rule(0, failure_domain_type=0))
+    osdm = om.OSDMap(crush, 6)
+    osdm.add_pool(
+        om.Pool(id=2, name="ecp", size=5, pg_num=8, type="erasure", crush_rule=0)
+    )
+    up0, _ = osdm.pg_to_up_acting_osds((2, 1))
+    assert len(up0) == 5
+    victim = up0[2]
+    osdm.apply_incremental(om.Incremental(epoch=2, down=[victim]))
+    up1, _ = osdm.pg_to_up_acting_osds((2, 1))
+    assert up1[2] == cm.ITEM_NONE  # positional hole, not shifted
+    assert [o for i, o in enumerate(up1) if i != 2] == [
+        o for i, o in enumerate(up0) if i != 2
+    ]
+
+
+def test_upmap_overrides():
+    crush = cm.build_flat(8)
+    crush.add_rule(cm.flat_firstn_rule(0))
+    osdm = om.OSDMap(crush, 8)
+    osdm.add_pool(om.Pool(id=1, name="p", size=3, pg_num=8))
+    pgid = (1, 5)
+    up0, _ = osdm.pg_to_up_acting_osds(pgid)
+    # full upmap
+    target = [o for o in range(8) if o not in up0][:3]
+    osdm.pg_upmap[pgid] = target
+    up1, _ = osdm.pg_to_up_acting_osds(pgid)
+    assert up1 == target
+    del osdm.pg_upmap[pgid]
+    # item remap
+    spare = [o for o in range(8) if o not in up0][0]
+    osdm.pg_upmap_items[pgid] = [(up0[1], spare)]
+    up2, _ = osdm.pg_to_up_acting_osds(pgid)
+    assert up2[1] == spare and up2[0] == up0[0] and up2[2] == up0[2]
+
+
+def test_reweight_shifts_load():
+    crush = cm.build_flat(4)
+    crush.add_rule(cm.flat_firstn_rule(0))
+    osdm = om.OSDMap(crush, 4)
+    osdm.add_pool(om.Pool(id=1, name="p", size=1, pg_num=256))
+    count_before = sum(
+        osdm.pg_to_up_acting_osds((1, ps))[0] == [3] for ps in range(256)
+    )
+    osdm.apply_incremental(om.Incremental(epoch=2, weights={3: 0x4000}))
+    count_after = sum(
+        osdm.pg_to_up_acting_osds((1, ps))[0] == [3] for ps in range(256)
+    )
+    assert count_after < count_before
+
+
+def test_str_hash_rjenkins_selfcheck():
+    # deterministic + length-sensitive + all tail sizes exercised
+    seen = set()
+    for n in range(0, 26):
+        h = om.ceph_str_hash_rjenkins(bytes(range(n)))
+        assert h not in seen
+        seen.add(h)
+    assert om.ceph_str_hash_rjenkins(b"foo") == om.ceph_str_hash_rjenkins(b"foo")
+
+
+def test_upmap_full_plus_items_compose():
+    """Reference semantics (OSDMap.cc:2682): a valid pg_upmap replaces
+    raw AND pg_upmap_items still apply on top; an invalid pg_upmap
+    short-circuits, leaving raw untouched and skipping items."""
+    crush = cm.build_flat(8)
+    crush.add_rule(cm.flat_firstn_rule(0))
+    osdm = om.OSDMap(crush, 8)
+    osdm.add_pool(om.Pool(id=1, name="p", size=3, pg_num=8))
+    pgid = (1, 2)
+    up0, _ = osdm.pg_to_up_acting_osds(pgid)
+    free = [o for o in range(8) if o not in up0]
+    osdm.pg_upmap[pgid] = [free[0], up0[1], up0[2]]
+    osdm.pg_upmap_items[pgid] = [(free[0], free[1])]
+    up1, _ = osdm.pg_to_up_acting_osds(pgid)
+    assert up1 == [free[1], up0[1], up0[2]]  # items applied on top
+    # invalidate the full upmap (target marked out): raw wins, items skipped
+    osdm.apply_incremental(om.Incremental(epoch=2, weights={free[0]: 0}))
+    osdm.pg_upmap_items[pgid] = [(up0[0], free[2])]
+    up2, _ = osdm.pg_to_up_acting_osds(pgid)
+    assert up2 == up0
