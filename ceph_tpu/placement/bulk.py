@@ -1,0 +1,446 @@
+"""Device bulk CRUSH rule engine: do_rule vectorized over object batches.
+
+The reference maps one input at a time through recursive C with
+data-dependent retries (mapper.c:438,633). The TPU-native form runs the
+same semantics as masked fixed-shape iteration over an entire batch:
+
+- the map compiles to dense arrays (bucket items/weights/sizes/types);
+- descent through the hierarchy is a static unroll over the map's max
+  depth (every lane walks in lockstep, finished lanes are masked);
+- the firstn retry loop and the indep round loop are lax.while_loop with
+  per-lane active masks — trip counts bounded by choose_total_tries, the
+  same bound the C uses;
+- straw2 draws, the reweight is_out test and Jenkins hashes are the
+  int64/uint32 kernels of ops/crush.py (bit-exact vs the C).
+
+Scope (v1): straw2 buckets, jewel-era tunables with
+choose_local_tries == choose_local_fallback_tries == 0 (their defaults
+since 2014), rules shaped take -> [set_*] -> choose|chooseleaf -> emit —
+the shape of every rule Ceph's own tooling generates. Anything else
+falls back to the host oracle (CrushMap.do_rule) transparently.
+
+Bit-exactness is asserted in tests against the host engine, which is
+itself verified against the compiled reference C (test_placement.py).
+"""
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops import crush as crush_ops
+from . import crushmap as cm
+
+ITEM_NONE = cm.ITEM_NONE
+ITEM_UNDEF = cm.ITEM_UNDEF
+_I32 = jnp.int32
+_U32 = jnp.uint32
+
+
+@dataclass(frozen=True)
+class CompiledRule:
+    take: int
+    op: str  # one of the four choose ops
+    numrep_arg: int  # raw arg1 (0 means result_max)
+    choose_type: int
+    choose_tries: int
+    recurse_tries: int
+    vary_r: int
+    stable: int
+
+
+class CompiledMap:
+    """Dense-array form of a straw2 CrushMap for device dispatch."""
+
+    def __init__(self, m: cm.CrushMap):
+        if any(b.alg != cm.ALG_STRAW2 for b in m.buckets.values()):
+            raise ValueError("device engine supports straw2 buckets only")
+        t = m.tunables
+        if t.choose_local_tries or t.choose_local_fallback_tries:
+            raise ValueError("local retries unsupported on device")
+        self.crushmap = m
+        nb = max(-bid for bid in m.buckets)
+        mi = max(b.size for b in m.buckets.values())
+        self.items = np.zeros((nb, mi), dtype=np.int32)
+        self.weights = np.zeros((nb, mi), dtype=np.uint32)
+        self.sizes = np.zeros(nb, dtype=np.int32)
+        self.btype = np.zeros(nb, dtype=np.int32)
+        for bid, b in m.buckets.items():
+            i = -1 - bid
+            self.items[i, : b.size] = b.items
+            self.weights[i, : b.size] = b.weights
+            self.sizes[i] = b.size
+            self.btype[i] = b.type_id
+        self.max_devices = m.max_devices
+        self.max_depth = self._depth()
+        self.tunables = t
+
+    def _depth(self) -> int:
+        depth = {}
+
+        def d(item: int) -> int:
+            if item >= 0:
+                return 0
+            if item not in depth:
+                b = self.crushmap.buckets[item]
+                depth[item] = 1 + max((d(i) for i in b.items), default=0)
+            return depth[item]
+
+        return max(d(bid) for bid in self.crushmap.buckets)
+
+    def compile_rule(self, ruleno: int, result_max: int) -> CompiledRule:
+        """Validate + flatten a take/set*/choose/emit rule."""
+        t = self.tunables
+        rule = self.crushmap.rules[ruleno]
+        take = None
+        choose = None
+        choose_tries = t.choose_total_tries + 1
+        choose_leaf_tries = 0
+        seen_emit = False
+        for s in rule.steps:
+            if s.op == cm.OP_TAKE:
+                if take is not None or choose is not None:
+                    raise ValueError("device engine: single take/choose only")
+                take = s.arg1
+            elif s.op == cm.OP_SET_CHOOSE_TRIES:
+                if s.arg1 > 0:
+                    choose_tries = s.arg1
+            elif s.op == cm.OP_SET_CHOOSELEAF_TRIES:
+                if s.arg1 > 0:
+                    choose_leaf_tries = s.arg1
+            elif s.op in (
+                cm.OP_CHOOSE_FIRSTN,
+                cm.OP_CHOOSELEAF_FIRSTN,
+                cm.OP_CHOOSE_INDEP,
+                cm.OP_CHOOSELEAF_INDEP,
+            ):
+                if choose is not None or take is None:
+                    raise ValueError("device engine: single choose only")
+                choose = s
+            elif s.op == cm.OP_EMIT:
+                seen_emit = True
+            else:
+                raise ValueError(f"device engine: unsupported op {s.op}")
+        if take is None or choose is None or not seen_emit:
+            raise ValueError("device engine: rule must take/choose/emit")
+        firstn = choose.op in (cm.OP_CHOOSE_FIRSTN, cm.OP_CHOOSELEAF_FIRSTN)
+        if firstn:
+            if choose_leaf_tries:
+                recurse = choose_leaf_tries
+            elif t.chooseleaf_descend_once:
+                recurse = 1
+            else:
+                recurse = choose_tries
+        else:
+            recurse = choose_leaf_tries or 1
+        return CompiledRule(
+            take=take,
+            op=choose.op,
+            numrep_arg=choose.arg1,
+            choose_type=choose.arg2,
+            choose_tries=choose_tries,
+            recurse_tries=recurse,
+            vary_r=t.chooseleaf_vary_r,
+            stable=t.chooseleaf_stable,
+        )
+
+
+# ------------------------------------------------------- device primitives
+
+
+def _straw2_choose_rows(cmap_arrays, bno, x, r):
+    """Per-lane straw2 choose: bno (N,) bucket row index, x (N,), r (N,).
+    Returns chosen item (N,) int32. Pad slots draw INT64_MIN, so an
+    all-dead bucket resolves to slot 0 — the same first-wins the C has."""
+    items, weights, sizes = cmap_arrays
+    its = items[bno]  # (N, MI)
+    ws = weights[bno]
+    r = jnp.broadcast_to(jnp.asarray(r, dtype=_I32), x.shape)
+    draws = crush_ops.straw2_draw(
+        x[:, None], its.astype(_U32), r[:, None].astype(_U32), ws
+    )
+    mi = its.shape[1]
+    valid = jnp.arange(mi, dtype=_I32)[None, :] < sizes[bno][:, None]
+    draws = jnp.where(valid, draws, jnp.int64(crush_ops.INT64_MIN))
+    win = jnp.argmax(draws, axis=-1)
+    return jnp.take_along_axis(its, win[:, None], axis=1)[:, 0]
+
+
+def _is_out(dev_weights, item, x):
+    """Vector is_out (mapper.c:401): probabilistic reweight rejection."""
+    w = dev_weights[jnp.clip(item, 0, dev_weights.shape[0] - 1)]
+    oob = item >= dev_weights.shape[0]
+    full = w >= _U32(0x10000)
+    zero = w == 0
+    h = crush_ops.hash32_2(x.astype(_U32), item.astype(_U32)) & _U32(0xFFFF)
+    return oob | (~full & (zero | (h >= w)))
+
+
+def _item_type(btype, item):
+    return jnp.where(item >= 0, 0, btype[jnp.clip(-1 - item, 0, btype.shape[0] - 1)])
+
+
+def _descend(cmap_arrays, btype, max_depth, start_bno, x, r, target_type, active):
+    """Walk from bucket rows start_bno down to items of target_type.
+    Returns (item, ok): ok lanes found a target-typed item."""
+    items, weights, sizes = cmap_arrays
+    cur = start_bno
+    found = jnp.full(x.shape, ITEM_NONE, dtype=_I32)
+    walking = active
+    for _ in range(max_depth):
+        empty = sizes[cur] == 0  # C rejects empty buckets (mapper.c:494)
+        item = _straw2_choose_rows(cmap_arrays, cur, x, r)
+        it = _item_type(btype, item)
+        hit = walking & ~empty & (it == target_type)
+        found = jnp.where(hit, item, found)
+        keep = walking & ~empty & ~hit & (item < 0)
+        cur = jnp.where(keep, -1 - item, cur)
+        walking = keep
+    return found, active & (found != ITEM_NONE)
+
+
+# ------------------------------------------------------------- firstn
+
+
+def _leaf_attempts(cmap_arrays, btype, max_depth, dev_weights, rule, R,
+                   host_item, r, pos, x, active, out2):
+    """Recursive chooseleaf: descend to a device, recurse_tries attempts,
+    r2 = (stable ? 0 : pos) + sub_r + ftotal2. The C recursion
+    collision-checks the leaf against out2[0..outpos-1] (it passes out2
+    as the recursion's out vector). Inner while_loop keeps the compiled
+    body at one descent regardless of recurse_tries."""
+    sub_r = r >> (rule.vary_r - 1) if rule.vary_r else jnp.zeros_like(r)
+    base = sub_r if rule.stable else pos + sub_r
+    slot_valid = jnp.arange(R, dtype=_I32)[None, :] < pos[:, None]
+    host_bno = jnp.clip(-1 - host_item, 0, btype.shape[0] - 1)
+
+    def body(carry):
+        leaf, pending, ft2 = carry
+        cand, ok = _descend(
+            cmap_arrays, btype, max_depth, host_bno,
+            x, base + ft2, 0, pending & (host_item < 0),
+        )
+        collide2 = jnp.any(slot_valid & (out2 == cand[:, None]), axis=-1)
+        ok = ok & ~collide2 & ~_is_out(dev_weights, cand, x)
+        leaf = jnp.where(pending & ok, cand, leaf)
+        return leaf, pending & ~ok, ft2 + 1
+
+    def cond(carry):
+        return jnp.any(carry[1]) & (carry[2] < rule.recurse_tries)
+
+    leaf0 = jnp.full(x.shape, ITEM_NONE, dtype=_I32)
+    leaf, _, _ = jax.lax.while_loop(
+        cond, body, (leaf0, active & (host_item < 0), jnp.zeros((), _I32))
+    )
+    # host_item may already be a device ("we already have a leaf")
+    leaf = jnp.where(active & (host_item >= 0), host_item, leaf)
+    return leaf, active & (leaf != ITEM_NONE)
+
+
+def _choose_firstn_vec(cmap_arrays, btype, max_depth, dev_weights, rule, R,
+                       root_bno, xs):
+    """Vectorized crush_choose_firstn + chooseleaf recursion.
+
+    The C's per-replica retry loops fold into ONE while_loop whose carry
+    tracks each lane's (rep, ftotal, pos): success advances rep and
+    resets ftotal, exhaustion (ftotal == tries) skips the rep — so the
+    compiled body holds a single descent, not R of them."""
+    n = xs.shape[0]
+    recurse_to_leaf = rule.op == cm.OP_CHOOSELEAF_FIRSTN
+    out = jnp.full((n, R), ITEM_NONE, dtype=_I32)
+    out2 = jnp.full((n, R), ITEM_NONE, dtype=_I32)
+    pos = jnp.zeros(n, dtype=_I32)
+    rep = jnp.zeros(n, dtype=_I32)
+    ftotal = jnp.zeros(n, dtype=_I32)
+
+    def body(carry):
+        out, out2, pos, rep, ftotal = carry
+        active = (rep < R) & (pos < R)
+        r = rep + ftotal
+        cand, ok = _descend(
+            cmap_arrays, btype, max_depth, root_bno, xs, r,
+            rule.choose_type, active,
+        )
+        slot_valid = jnp.arange(R, dtype=_I32)[None, :] < pos[:, None]
+        collide = jnp.any(slot_valid & (out == cand[:, None]), axis=-1) & ok
+        ok = ok & ~collide
+        if recurse_to_leaf:
+            leaf, leaf_ok = _leaf_attempts(
+                cmap_arrays, btype, max_depth, dev_weights, rule, R,
+                cand, r, pos, xs, ok, out2,
+            )
+            ok = ok & leaf_ok
+        else:
+            leaf = cand
+        if rule.choose_type == 0:
+            ok = ok & ~_is_out(dev_weights, cand, xs)
+        success = active & ok
+        onehot = jnp.arange(R, dtype=_I32)[None, :] == pos[:, None]
+        write = onehot & success[:, None]
+        out = jnp.where(write, cand[:, None], out)
+        out2 = jnp.where(write, leaf[:, None], out2)
+        pos = pos + success.astype(_I32)
+        fail = active & ~success
+        exhausted = fail & (ftotal + 1 >= rule.choose_tries)
+        rep = rep + success.astype(_I32) + exhausted.astype(_I32)
+        ftotal = jnp.where(success | exhausted, 0, ftotal + fail.astype(_I32))
+        return out, out2, pos, rep, ftotal
+
+    def cond(carry):
+        _, _, pos, rep, _ = carry
+        return jnp.any((rep < R) & (pos < R))
+
+    out, out2, pos, rep, ftotal = jax.lax.while_loop(
+        cond, body, (out, out2, pos, rep, ftotal)
+    )
+    return out2 if recurse_to_leaf else out, pos
+
+
+# -------------------------------------------------------------- indep
+
+
+def _choose_indep_vec(cmap_arrays, btype, max_depth, dev_weights, rule, R,
+                      root_bno, xs):
+    """Vectorized crush_choose_indep + chooseleaf recursion (positional).
+
+    The C's round structure (for ftotal: for rep: retry UNDEF slots) is
+    scanned one (ftotal, rep) pair per while_loop iteration — rep and
+    ftotal are scalar carry, so the body compiles one descent. All lanes
+    share the scan position; lanes whose slot is already placed no-op."""
+    n = xs.shape[0]
+    recurse_to_leaf = rule.op == cm.OP_CHOOSELEAF_INDEP
+    numrep = R
+    out = jnp.full((n, R), ITEM_UNDEF, dtype=_I32)
+    out2 = jnp.full((n, R), ITEM_UNDEF, dtype=_I32)
+
+    def leaf_indep(host_item, parent_r, rep, x, active):
+        """Recursive indep chooseleaf: left=1 at position rep, its own
+        recurse_tries round loop (inner while, one descent in body)."""
+        host_bno = jnp.clip(-1 - host_item, 0, btype.shape[0] - 1)
+
+        def body(carry):
+            leaf, ft2 = carry
+            pending = active & (leaf == ITEM_UNDEF)
+            r2 = rep + parent_r + numrep * ft2
+            cand, ok = _descend(
+                cmap_arrays, btype, max_depth, host_bno,
+                x, r2, 0, pending & (host_item < 0),
+            )
+            ok = ok & ~_is_out(dev_weights, cand, x)
+            leaf = jnp.where(pending & ok, cand, leaf)
+            return leaf, ft2 + 1
+
+        def cond(carry):
+            leaf, ft2 = carry
+            return jnp.any(active & (leaf == ITEM_UNDEF)) & (
+                ft2 < rule.recurse_tries
+            )
+
+        leaf0 = jnp.full(x.shape, ITEM_UNDEF, dtype=_I32)
+        leaf, _ = jax.lax.while_loop(cond, body, (leaf0, jnp.zeros((), _I32)))
+        leaf = jnp.where(active & (host_item >= 0), host_item, leaf)
+        return leaf
+
+    def body(carry):
+        out, out2, rep, ftotal = carry
+        slot = jnp.take_along_axis(
+            out, jnp.broadcast_to(rep, (n,))[:, None], axis=1
+        )[:, 0]
+        pending = slot == ITEM_UNDEF
+        r = rep + numrep * ftotal
+        cand, ok = _descend(
+            cmap_arrays, btype, max_depth, root_bno, xs, r,
+            rule.choose_type, pending,
+        )
+        collide = jnp.any(out == cand[:, None], axis=-1) & ok
+        ok = ok & ~collide
+        if recurse_to_leaf:
+            leaf = leaf_indep(cand, r, rep, xs, ok)
+            ok = ok & (leaf != ITEM_UNDEF)
+        else:
+            leaf = cand
+        if rule.choose_type == 0:
+            ok = ok & ~_is_out(dev_weights, cand, xs)
+        success = pending & ok
+        col = jnp.arange(R, dtype=_I32)[None, :] == rep
+        out = jnp.where(col & success[:, None], cand[:, None], out)
+        out2 = jnp.where(col & success[:, None], leaf[:, None], out2)
+        last = rep == R - 1
+        rep = jnp.where(last, 0, rep + 1)
+        ftotal = ftotal + last.astype(_I32)
+        return out, out2, rep, ftotal
+
+    def cond(carry):
+        out, _, _, ftotal = carry
+        return jnp.any(out == ITEM_UNDEF) & (ftotal < rule.choose_tries)
+
+    out, out2, _, _ = jax.lax.while_loop(
+        cond, body,
+        (out, out2, jnp.zeros((), dtype=_I32), jnp.zeros((), dtype=_I32)),
+    )
+    res = out2 if recurse_to_leaf else out
+    return jnp.where(res == ITEM_UNDEF, ITEM_NONE, res)
+
+
+# --------------------------------------------------------------- dispatch
+
+
+@functools.lru_cache(maxsize=64)
+def _jit_engine(op: str):
+    def run(items, weights, sizes, btype, dev_weights, xs, *, static):
+        rule, R, max_depth, root_bno = static
+        arrays = (items, weights, sizes)
+        root = jnp.full(xs.shape, root_bno, dtype=_I32)
+        if op in (cm.OP_CHOOSE_FIRSTN, cm.OP_CHOOSELEAF_FIRSTN):
+            out, pos = _choose_firstn_vec(
+                arrays, btype, max_depth, dev_weights, rule, R, root, xs
+            )
+            return out, pos
+        out = _choose_indep_vec(
+            arrays, btype, max_depth, dev_weights, rule, R, root, xs
+        )
+        return out, jnp.full(xs.shape, R, dtype=_I32)
+
+    return jax.jit(run, static_argnames=("static",))
+
+
+def do_rule_bulk(
+    compiled: CompiledMap,
+    ruleno: int,
+    xs: np.ndarray,
+    numrep: int,
+    weights: np.ndarray | None = None,
+    chunk: int = 1 << 18,
+) -> np.ndarray:
+    """(N,) placement inputs -> (N, numrep) int32 osds (ITEM_NONE holes).
+
+    firstn results are compacted per lane like the C (no holes, short
+    rows padded with ITEM_NONE at the tail); indep results are
+    positional. Dispatches in host-side chunks to bound device memory.
+    """
+    rule = compiled.compile_rule(ruleno, numrep)
+    nr = rule.numrep_arg if rule.numrep_arg > 0 else numrep + rule.numrep_arg
+    r_eff = min(nr, numrep)
+    if weights is None:
+        weights = np.full(compiled.max_devices, 0x10000, dtype=np.uint32)
+    xs = np.ascontiguousarray(xs, dtype=np.uint32)
+    root_bno = -1 - rule.take
+    fn = _jit_engine(rule.op)
+    outs = []
+    static = (rule, r_eff, compiled.max_depth, root_bno)
+    with jax.enable_x64():
+        args = (
+            jnp.asarray(compiled.items),
+            jnp.asarray(compiled.weights),
+            jnp.asarray(compiled.sizes),
+            jnp.asarray(compiled.btype),
+            jnp.asarray(np.ascontiguousarray(weights, dtype=np.uint32)),
+        )
+        for lo in range(0, len(xs), chunk):
+            part = jnp.asarray(xs[lo : lo + chunk])
+            out, _pos = fn(*args, part, static=static)
+            outs.append(np.asarray(out))
+    return np.concatenate(outs, axis=0)

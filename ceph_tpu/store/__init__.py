@@ -1,0 +1,34 @@
+"""Local object store layer.
+
+Mirrors the reference's ObjectStore seam (src/os/ObjectStore.h:63):
+collections (one per PG) hold objects with three facets — byte data,
+xattrs, and omap (sorted key/value) — and all mutations flow through
+transactional redo logs (src/os/Transaction.h:110-155 op set) applied
+atomically by ``queue_transaction`` (ObjectStore.h:223).
+
+Implementations:
+- ``MemStore`` (memstore.py) — dict-backed test double, the reference's
+  src/os/memstore role; used by OSD-lite processes and tests.
+- ``FileStoreLite`` (filestore.py) — persistent single-file store with a
+  write-ahead log and batched CRC32C blob checksums through the device
+  Checksummer path (the BlueStore-shaped store).
+
+Factory: ``create(kind, path)`` mirroring ObjectStore::create
+(src/os/ObjectStore.cc:30-62).
+"""
+from __future__ import annotations
+
+from .transaction import Transaction  # noqa: F401
+from .base import ObjectStore, StoreError, NotFound, Collection  # noqa: F401
+from .memstore import MemStore  # noqa: F401
+
+
+def create(kind: str, path: str | None = None, **kw) -> ObjectStore:
+    """ObjectStore::create-style factory (os/ObjectStore.cc:30)."""
+    if kind == "memstore":
+        return MemStore()
+    if kind == "filestore":
+        from .filestore import FileStoreLite
+
+        return FileStoreLite(path, **kw)
+    raise ValueError(f"unknown store kind {kind!r}")

@@ -1,0 +1,203 @@
+"""ObjectStore interface + shared transaction application logic.
+
+The contract of src/os/ObjectStore.h:63: collections of objects, each
+object a (data, xattrs, omap) triple; reads are synchronous; writes are
+queued transactions with an on_commit callback fired once durable.
+Stores that keep state in memory can implement `_obj`/`_coll` accessors
+and inherit the op interpreter, the way MemStore does in the reference
+(src/os/memstore/MemStore.cc do_transaction).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Iterable
+
+from . import transaction as tx
+
+
+class StoreError(Exception):
+    pass
+
+
+class NotFound(StoreError):
+    pass
+
+
+class Collection:
+    """One collection (= one PG's objects). In-memory representation."""
+
+    def __init__(self, cid: str):
+        self.cid = cid
+        self.objects: dict[bytes, Obj] = {}
+
+
+class Obj:
+    __slots__ = ("data", "xattrs", "omap", "omap_header")
+
+    def __init__(self):
+        self.data = bytearray()
+        self.xattrs: dict[str, bytes] = {}
+        self.omap: dict[bytes, bytes] = {}
+        self.omap_header = b""
+
+    def clone(self) -> "Obj":
+        o = Obj()
+        o.data = bytearray(self.data)
+        o.xattrs = dict(self.xattrs)
+        o.omap = dict(self.omap)
+        o.omap_header = self.omap_header
+        return o
+
+
+class ObjectStore:
+    """Abstract store; subclasses provide durability."""
+
+    def mount(self) -> None: ...
+
+    def umount(self) -> None: ...
+
+    # ------------------------------------------------------------- writes
+
+    def queue_transaction(
+        self, t: tx.Transaction, on_commit: Callable[[], None] | None = None
+    ) -> None:
+        raise NotImplementedError
+
+    def apply_transaction(self, t: tx.Transaction) -> None:
+        """Synchronous convenience: queue + wait."""
+        done = threading.Event()
+        self.queue_transaction(t, done.set)
+        done.wait()
+
+    # -------------------------------------------------------------- reads
+
+    def read(self, cid: str, oid: bytes, offset: int = 0, length: int = -1) -> bytes:
+        raise NotImplementedError
+
+    def stat(self, cid: str, oid: bytes) -> int:
+        """Object size in bytes (raises NotFound)."""
+        raise NotImplementedError
+
+    def exists(self, cid: str, oid: bytes) -> bool:
+        try:
+            self.stat(cid, oid)
+            return True
+        except NotFound:
+            return False
+
+    def getattr(self, cid: str, oid: bytes, name: str) -> bytes:
+        raise NotImplementedError
+
+    def getattrs(self, cid: str, oid: bytes) -> dict[str, bytes]:
+        raise NotImplementedError
+
+    def omap_get(self, cid: str, oid: bytes) -> dict[bytes, bytes]:
+        raise NotImplementedError
+
+    def omap_get_header(self, cid: str, oid: bytes) -> bytes:
+        raise NotImplementedError
+
+    def list_collections(self) -> list[str]:
+        raise NotImplementedError
+
+    def list_objects(self, cid: str) -> list[bytes]:
+        raise NotImplementedError
+
+    # --------------------------------------------- shared op interpreter
+
+    def _get_coll(self, cid: str) -> Collection:
+        raise NotImplementedError
+
+    def _do_op(self, colls: dict[str, Collection], op: tx.Op) -> None:
+        """Interpret one op against in-memory collections (the MemStore
+        do_transaction role; FileStoreLite replays the same ops)."""
+        if op.code == tx.OP_MKCOLL:
+            if op.cid in colls:
+                raise StoreError(f"collection {op.cid} exists")
+            colls[op.cid] = Collection(op.cid)
+            return
+        if op.code == tx.OP_RMCOLL:
+            c = colls.get(op.cid)
+            if c is None:
+                raise NotFound(op.cid)
+            if c.objects:
+                raise StoreError(f"collection {op.cid} not empty")
+            del colls[op.cid]
+            return
+        c = colls.get(op.cid)
+        if c is None:
+            raise NotFound(f"collection {op.cid}")
+        a = op.args
+        if op.code == tx.OP_TOUCH:
+            c.objects.setdefault(op.oid, Obj())
+            return
+        if op.code == tx.OP_REMOVE:
+            if op.oid not in c.objects:
+                raise NotFound(repr(op.oid))
+            del c.objects[op.oid]
+            return
+        if op.code == tx.OP_CLONE:
+            src = c.objects.get(op.oid)
+            if src is None:
+                raise NotFound(repr(op.oid))
+            c.objects[a["dest"]] = src.clone()
+            return
+        if op.code == tx.OP_CLONERANGE:
+            src = c.objects.get(op.oid)
+            if src is None:
+                raise NotFound(repr(op.oid))
+            dst = c.objects.setdefault(a["dest"], Obj())
+            data = bytes(src.data[a["src_off"] : a["src_off"] + a["length"]])
+            end = a["dst_off"] + len(data)
+            if len(dst.data) < end:
+                dst.data.extend(b"\0" * (end - len(dst.data)))
+            dst.data[a["dst_off"] : end] = data
+            return
+        o = c.objects.get(op.oid)
+        if o is None:
+            # write-type ops create; read-modify ops demand existence
+            if op.code in (
+                tx.OP_WRITE, tx.OP_ZERO, tx.OP_TRUNCATE, tx.OP_SETATTR,
+                tx.OP_SETATTRS, tx.OP_OMAP_SETKEYS, tx.OP_OMAP_SETHEADER,
+            ):
+                o = c.objects.setdefault(op.oid, Obj())
+            else:
+                raise NotFound(repr(op.oid))
+        if op.code == tx.OP_WRITE:
+            end = a["offset"] + len(a["data"])
+            if len(o.data) < end:
+                o.data.extend(b"\0" * (end - len(o.data)))
+            o.data[a["offset"] : end] = a["data"]
+        elif op.code == tx.OP_ZERO:
+            end = a["offset"] + a["length"]
+            if len(o.data) < end:
+                o.data.extend(b"\0" * (end - len(o.data)))
+            o.data[a["offset"] : end] = b"\0" * a["length"]
+        elif op.code == tx.OP_TRUNCATE:
+            size = a["size"]
+            if size < len(o.data):
+                del o.data[size:]
+            else:
+                o.data.extend(b"\0" * (size - len(o.data)))
+        elif op.code == tx.OP_SETATTR:
+            o.xattrs[a["name"]] = a["value"]
+        elif op.code == tx.OP_SETATTRS:
+            o.xattrs.update(a["attrs"])
+        elif op.code == tx.OP_RMATTR:
+            o.xattrs.pop(a["name"], None)
+        elif op.code == tx.OP_RMATTRS:
+            o.xattrs.clear()
+        elif op.code == tx.OP_OMAP_CLEAR:
+            o.omap.clear()
+        elif op.code == tx.OP_OMAP_SETKEYS:
+            o.omap.update(a["kv"])
+        elif op.code == tx.OP_OMAP_RMKEYS:
+            for k in a["keys"]:
+                o.omap.pop(k, None)
+        elif op.code == tx.OP_OMAP_RMKEYRANGE:
+            for k in [k for k in o.omap if a["first"] <= k < a["last"]]:
+                del o.omap[k]
+        elif op.code == tx.OP_OMAP_SETHEADER:
+            o.omap_header = a["header"]
+        else:
+            raise StoreError(f"unknown op {op.code}")

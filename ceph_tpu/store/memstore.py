@@ -1,0 +1,94 @@
+"""MemStore: dict-backed ObjectStore (the reference src/os/memstore role).
+
+The cluster-free test double (SURVEY.md §4 tier 2): transactions apply
+synchronously under one lock with all-or-nothing semantics (ops applied
+to a shadow of the touched collections, swapped in on success).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable
+
+from . import transaction as tx
+from .base import Collection, NotFound, ObjectStore
+
+
+class MemStore(ObjectStore):
+    def __init__(self) -> None:
+        self.colls: dict[str, Collection] = {}
+        self.lock = threading.RLock()
+
+    # ------------------------------------------------------------- writes
+
+    def queue_transaction(
+        self, t: tx.Transaction, on_commit: Callable[[], None] | None = None
+    ) -> None:
+        with self.lock:
+            # all-or-nothing: run against a shallow copy of the coll map
+            # with cloned touched collections; commit by swap
+            touched = {op.cid for op in t.ops}
+            shadow = dict(self.colls)
+            for cid in touched:
+                if cid in shadow:
+                    c = Collection(cid)
+                    c.objects = {
+                        oid: o.clone() for oid, o in shadow[cid].objects.items()
+                    }
+                    shadow[cid] = c
+            for op in t.ops:
+                self._do_op(shadow, op)
+            self.colls = shadow
+        if on_commit:
+            on_commit()
+
+    # -------------------------------------------------------------- reads
+
+    def _coll(self, cid: str) -> Collection:
+        c = self.colls.get(cid)
+        if c is None:
+            raise NotFound(f"collection {cid}")
+        return c
+
+    def _obj(self, cid: str, oid: bytes):
+        o = self._coll(cid).objects.get(oid)
+        if o is None:
+            raise NotFound(repr(oid))
+        return o
+
+    def read(self, cid: str, oid: bytes, offset: int = 0, length: int = -1) -> bytes:
+        with self.lock:
+            o = self._obj(cid, oid)
+            if length < 0:
+                return bytes(o.data[offset:])
+            return bytes(o.data[offset : offset + length])
+
+    def stat(self, cid: str, oid: bytes) -> int:
+        with self.lock:
+            return len(self._obj(cid, oid).data)
+
+    def getattr(self, cid: str, oid: bytes, name: str) -> bytes:
+        with self.lock:
+            attrs = self._obj(cid, oid).xattrs
+            if name not in attrs:
+                raise NotFound(name)
+            return attrs[name]
+
+    def getattrs(self, cid: str, oid: bytes) -> dict[str, bytes]:
+        with self.lock:
+            return dict(self._obj(cid, oid).xattrs)
+
+    def omap_get(self, cid: str, oid: bytes) -> dict[bytes, bytes]:
+        with self.lock:
+            return dict(self._obj(cid, oid).omap)
+
+    def omap_get_header(self, cid: str, oid: bytes) -> bytes:
+        with self.lock:
+            return self._obj(cid, oid).omap_header
+
+    def list_collections(self) -> list[str]:
+        with self.lock:
+            return sorted(self.colls)
+
+    def list_objects(self, cid: str) -> list[bytes]:
+        with self.lock:
+            return sorted(self._coll(cid).objects)

@@ -1,0 +1,10 @@
+"""L0 platform primitives (reference: src/common).
+
+- ``denc`` — little-endian binary encoding helpers (the denc.h role).
+- ``config`` — typed option schema + runtime config with observers
+  (the md_config_t / ConfigProxy role).
+- ``perf`` — counters registry (the PerfCounters role).
+- ``throttle`` — byte/op budget gate (the Throttle role).
+- ``fault`` — fault injection points (the FaultInjector role).
+"""
+from . import denc  # noqa: F401
