@@ -29,12 +29,71 @@ STRIPE_AXIS = "stripe"
 WIDTH_AXIS = "width"
 
 
+def pin_virtual_cpu(n: int) -> None:
+    """Pin jax to an n-device virtual CPU platform BEFORE any backend init.
+
+    Used by tests (conftest) and the driver's multi-chip dry run: the host
+    may carry a broken/mismatched accelerator plugin (libtpu AOT/terminal
+    version skew) whose init poisons every later device_put, and sharding
+    validation never needs real chips. The env vars must be set before the
+    first backend init; jax.config.update("jax_platforms", ...) is what
+    the axon plugin actually respects (it ignores the JAX_PLATFORMS env
+    var). XLA parses XLA_FLAGS once per process, so this cannot rescue a
+    process whose backends already initialized with fewer CPU devices —
+    it raises with a clear message instead (run in a fresh process).
+    """
+    import os
+    import re
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    flag_re = r"--xla_force_host_platform_device_count=(\d+)"
+    m = re.search(flag_re, flags)
+    if m is None:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}"
+        ).strip()
+    elif int(m.group(1)) < n:
+        os.environ["XLA_FLAGS"] = re.sub(
+            flag_re, f"--xla_force_host_platform_device_count={n}", flags
+        )
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        cpus = jax.devices("cpu")
+    except RuntimeError:
+        cpus = []
+    if len(cpus) < n:
+        raise RuntimeError(
+            f"virtual CPU mesh has {len(cpus)} devices; need {n} — a jax "
+            "backend initialized before pin_virtual_cpu could set "
+            "XLA_FLAGS; call it first (or use a fresh process)"
+        )
+
+
+def _platform_healthy(devs) -> bool:
+    """True when a trivial transfer to devs[0] succeeds.
+
+    A mismatched accelerator plugin (e.g. libtpu AOT/terminal version skew)
+    can enumerate devices but fail every device_put; count alone is not a
+    health check."""
+    try:
+        x = jax.device_put(np.zeros(1, np.uint8), devs[0])
+        jax.block_until_ready(x)
+        return True
+    except Exception:
+        return False
+
+
 def get_devices(n: int):
-    """n devices for a mesh: the default backend's if it has enough, else
-    the virtual-CPU backend's (xla_force_host_platform_device_count) —
-    the driver's multi-chip dry-run path on single-chip hosts."""
-    devs = jax.devices()
-    if len(devs) >= n:
+    """n devices for a mesh: the default backend's if it has enough AND
+    works, else the virtual-CPU backend's
+    (xla_force_host_platform_device_count) — the driver's multi-chip
+    dry-run path on single-chip hosts."""
+    try:
+        devs = jax.devices()
+    except RuntimeError:
+        devs = []
+    if len(devs) >= n and _platform_healthy(devs):
         return devs[:n]
     try:
         cpu = jax.devices("cpu")

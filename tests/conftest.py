@@ -5,25 +5,15 @@ run on 8 virtual CPU devices, mirroring how the reference tests cluster
 logic without a cluster (MemStore / vstart tiers, SURVEY.md §4). Bench
 (`bench.py`) runs separately on the real TPU chip.
 
-This must run before jax is imported anywhere in the test process.
+pin_virtual_cpu must run before the first jax backend init (importing jax
+is fine; creating devices is not).
 """
-import os
-
 # Force, not setdefault: the shell env pre-sets JAX_PLATFORMS=axon (the
 # real chip tunnel), which would pin tests to 1 TPU device and slow
 # compiles. Tests always use the virtual 8-CPU mesh; bench.py uses the chip.
-# The axon PJRT plugin ignores the JAX_PLATFORMS env var, so the config
-# update below (which it does respect) is what actually filters it out.
-os.environ["JAX_PLATFORMS"] = "cpu"
-xla_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in xla_flags:
-    os.environ["XLA_FLAGS"] = (
-        xla_flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+from ceph_tpu import parallel
 
-import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
+parallel.pin_virtual_cpu(8)
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
