@@ -2,19 +2,47 @@
 """Headline benchmark: EC encode + 2-erasure decode, k=8, m=3, 4 MiB stripes.
 
 Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": "GiB/s", "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": "GiB/s", "vs_baseline": N, ...}
 
 value        — aggregate device throughput in data-GiB/s for one encode
                plus one degraded decode pass over the stripe batch (the
                north-star BASELINE.json configs 2+3 shape).
 vs_baseline  — speedup over the same math on the host CPU via the C++
-               native core (the reference's jerasure/ISA-L role;
-               table-driven GF(2^8), multithreaded across all cores).
+               native core (the reference's jerasure/ISA-L role:
+               table-driven GF(2^8), matrix inverted once, the whole
+               batch in one multithreaded matmul call). The host core
+               count is recorded in the output — on a 1-vCPU driver host
+               the baseline is necessarily single-core.
+
+Measurement methodology (round-1 verdict forced a redesign, and round-2
+probing found why: on this tunnel-attached chip `block_until_ready`
+returns before remote execution finishes, and a host<->device round trip
+costs ~105 ms — both round-1 numbers were artifacts):
+- completion is forced by reading back a value that DEPENDS on every
+  timed output (async-dispatch + block_until_ready measures dispatch,
+  not execution, over the tunnel);
+- the fixed round-trip latency is measured separately with a trivial
+  kernel and subtracted; iteration counts keep it a minor correction;
+- every timed iteration consumes a provably distinct input: a pre-staged
+  base XORed (inside the jitted kernel, fused — no extra HBM pass) with
+  a per-iteration salt;
+- timed kernels return only per-chunk CRCs (a few bytes) whose values
+  depend on every output word, so XLA cannot elide work and outputs
+  cannot accumulate in HBM;
+- a roofline tripwire refuses to print a number whose implied HBM
+  traffic exceeds the chip's spec bandwidth;
+- bit-exactness is checked untimed on a full batch: device parity vs the
+  C++ host core, device repair vs the original data, every stripe;
+- extra BASELINE.json configs ride along in the same JSON line:
+  (1) k=2,m=1 4 KiB single-stripe encode latency,
+  (4) batched crc32c over 64 KiB blobs,
+  (5) straw2 bulk placement over a 1 K-OSD bucket.
 
 Run with no JAX_PLATFORMS override so the real TPU chip is used.
 """
 from __future__ import annotations
 
+import functools
 import json
 import os
 import sys
@@ -25,88 +53,325 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
 
 from ceph_tpu import native  # noqa: E402
 from ceph_tpu.models import datapath  # noqa: E402
-from ceph_tpu.ops import rs  # noqa: E402
+from ceph_tpu.ops import crc32c as crc_ops  # noqa: E402
+from ceph_tpu.ops import crush as crush_ops  # noqa: E402
+from ceph_tpu.ops import gf8, rs  # noqa: E402
 
 K, M = 8, 3
 CHUNK = 512 * 1024  # 4 MiB stripe / k
 BATCH = 24  # 96 MiB data per dispatch
 ERASED = (1, 6)  # two lost data shards
 PRESENT = tuple([i for i in range(K) if i not in ERASED] + [K, K + 1])
-ITERS = 10
+ITERS = 24
+THREADS = os.cpu_count() or 1
+
+# Roofline tripwire. The one real chip is a v5e ("TPU v5 lite"): ~819 GB/s
+# HBM. A measured time implying more traffic than the spec allows means the
+# timing loop is broken (caching/elision), not that the chip is fast.
+HBM_BYTES_PER_S = 819e9
+ROOFLINE_SLACK = 1.25  # measurement noise allowance
 
 
-def device_pass(data: jax.Array):
+def _sync(x) -> None:
+    """Force actual completion of everything x depends on (device_get of
+    a scalar blocks on remote execution; block_until_ready does not)."""
+    np.asarray(jax.device_get(jnp.ravel(x)[0]))
+
+
+def _progress(msg: str) -> None:
+    print(f"[bench {time.strftime('%H:%M:%S')}] {msg}", file=sys.stderr,
+          flush=True)
+
+
+def measure_latency() -> float:
+    """Fixed host<->device round-trip cost of the readback sync."""
+    tiny = jax.jit(lambda x: x + 1)
+    t = jnp.zeros(8, jnp.uint32)
+    _sync(tiny(t))
+    samples = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        _sync(tiny(t))
+        samples.append(time.perf_counter() - t0)
+    return float(np.median(samples))
+
+
+def _timed_chain(fn, salts, latency: float) -> float:
+    """Seconds per call of fn(salt), latency-subtracted.
+
+    fn must return a small array depending on all its work. One readback
+    forces the whole chain; per-call cost amortizes the round trip.
+    """
+    # warm chain: compiles fn AND the scalar sum-tree kernels (their
+    # first-use compile otherwise lands inside the timed region)
+    warm = [fn(s) for s in salts[:2]]
+    _sync(sum(jnp.sum(p.astype(jnp.uint32)) for p in warm))
+
+    t0 = time.perf_counter()  # clock covers dispatch too — execution can
+    probes = [fn(s) for s in salts]  # begin as soon as the first enqueue
+    acc = sum(jnp.sum(p.astype(jnp.uint32)) for p in probes)
+    _sync(acc)
+    wall = time.perf_counter() - t0
+    return max(wall - latency, 1e-9) / len(salts)
+
+
+def headline(latency: float) -> dict:
+    """Configs 2+3: batched encode + 2-erasure decode, k=8 m=3, 4 MiB."""
     params = datapath.ECParams(k=K, m=M, chunk_bytes=CHUNK)
+    surv_rows = [i for i in PRESENT if i < K]
+    rmat = gf8.decode_matrix(params.matrix, K, list(PRESENT))
+
+    base = jax.random.bits(jax.random.key(42), (BATCH, K, params.words),
+                           dtype=jnp.uint32)
+    salts = [jnp.uint32(0x9E3779B9 * (i + 1) & 0xFFFFFFFF)
+             for i in range(ITERS)]
+
+    @jax.jit
+    def enc_probe_2(b, salt):
+        # Salted input fuses into the matmul read; only CRCs (which cover
+        # every data+parity word) leave the device. b is an argument, not
+        # a closure constant (constants ship with the compile request).
+        _, crcs = datapath.write_step(params, b ^ salt)
+        return crcs
+
+    @jax.jit
+    def dec_probe_2(b, salt):
+        surv = (b ^ salt)[:, : len(PRESENT), :]  # shape (B, k, W)
+        decoded = rs.gf_matmul_u32(rmat, surv)
+        return crc_ops.crc32c_words_device(
+            decoded, crc_ops.zeros_shift(datapath.CRC_SEED, CHUNK)
+        )
+
+    enc_probe = functools.partial(enc_probe_2, base)
+    dec_probe = functools.partial(dec_probe_2, base)
+
+    _sync(enc_probe(salts[0]))
+    _sync(dec_probe(salts[0]))
+    dt_enc = _timed_chain(enc_probe, salts, latency)
+    dt_dec = _timed_chain(dec_probe, salts, latency)
+    dt = dt_enc + dt_dec
+
+    data_bytes = BATCH * K * CHUNK
+    # Minimum HBM traffic per iteration: both passes read a data-sized
+    # input; outputs may fuse away into the CRC tree.
+    traffic = 2 * data_bytes
+    implied = traffic / dt
+    if implied > HBM_BYTES_PER_S * ROOFLINE_SLACK:
+        raise RuntimeError(
+            f"implied HBM bandwidth {implied / 1e9:.0f} GB/s exceeds the "
+            f"chip spec {HBM_BYTES_PER_S / 1e9:.0f} GB/s — timing loop is "
+            "measuring dispatch, not execution"
+        )
+    gibs_dev = 2 * data_bytes / dt / 2**30
+
+    # ---- untimed full-batch bit-exactness: encode + repair round trip
     enc = datapath.jit_write_step(params)
     dec = datapath.jit_repair_step(params, PRESENT)
+    parity, _ = enc(base)
 
-    parity, crcs = enc(data)
-    surviving = jax.numpy.concatenate(
-        [data[:, [i for i in PRESENT if i < K], :], parity[:, : len(ERASED), :]],
-        axis=1,
-    )
-    decoded, _ = dec(surviving)
-    jax.block_until_ready((parity, crcs, decoded))
-
-    t0 = time.perf_counter()
-    for _ in range(ITERS):
-        parity, crcs = enc(data)
-        decoded, _ = dec(surviving)
-    jax.block_until_ready((parity, crcs, decoded))
-    dt = (time.perf_counter() - t0) / ITERS
-    return dt, np.asarray(parity), np.asarray(decoded)
-
-
-def host_pass(data_u8: np.ndarray, threads: int) -> float:
-    params = datapath.ECParams(k=K, m=M, chunk_bytes=CHUNK)
-    n = data_u8.shape[0]
-    flat = data_u8.reshape(n, K * CHUNK)  # stripes are independent on host
-    # warm + correctness handled by tests; time one encode+decode pass
-    t0 = time.perf_counter()
-    for s in range(n):
-        chunks = flat[s].reshape(K, CHUNK)
-        parity = native.rs_encode(params.matrix, chunks, threads=threads)
-        surv = np.concatenate(
-            [chunks[[i for i in PRESENT if i < K]], parity[: len(ERASED)]], axis=0
+    @jax.jit
+    def build_surviving(data, parity):
+        return jnp.concatenate(
+            [data[:, surv_rows, :], parity[:, : len(ERASED), :]], axis=1
         )
-        native.rs_decode(params.matrix, list(PRESENT), surv)
-    return time.perf_counter() - t0
+
+    decoded, _ = dec(build_surviving(base, parity))
+    host_in = rs.unpack_u32(np.asarray(base))  # (B, K, CHUNK)
+    host_par = rs.unpack_u32(np.asarray(parity))  # (B, M, CHUNK)
+    if not (rs.unpack_u32(np.asarray(decoded)) == host_in).all():
+        raise AssertionError("device repair differs from original data")
+    flat = np.ascontiguousarray(host_in.transpose(1, 0, 2)).reshape(
+        K, BATCH * CHUNK
+    )
+    want = native.rs_encode(params.matrix, flat, threads=THREADS)
+    got_flat = np.ascontiguousarray(host_par.transpose(1, 0, 2)).reshape(
+        M, BATCH * CHUNK
+    )
+    if not (got_flat == want).all():
+        raise AssertionError("device parity differs from host reference")
+
+    # ---- honest host baseline: same math, matrix inversion once, whole
+    # batch as ONE multithreaded C++ matmul per direction (ISA-L shape).
+    surv_flat = np.concatenate(
+        [
+            np.ascontiguousarray(host_in[:, surv_rows, :].transpose(1, 0, 2)),
+            np.ascontiguousarray(
+                host_par[:, : len(ERASED), :].transpose(1, 0, 2)
+            ),
+        ],
+        axis=0,
+    ).reshape(K, BATCH * CHUNK)
+    t0 = time.perf_counter()
+    native.rs_encode(params.matrix, flat, threads=THREADS)
+    native.rs_matmul(rmat, surv_flat, threads=THREADS)
+    dt_host = time.perf_counter() - t0
+    gibs_host = 2 * data_bytes / dt_host / 2**30
+
+    return {
+        "metric": "ec_encode_plus_2erasure_decode_k8m3_4MiB_stripes",
+        "value": round(gibs_dev, 3),
+        "unit": "GiB/s",
+        "vs_baseline": round(gibs_dev / gibs_host, 2),
+        "host_gibs": round(gibs_host, 3),
+        "host_threads": THREADS,
+        "hbm_roofline_frac": round(implied / HBM_BYTES_PER_S, 3),
+        "tunnel_latency_ms": round(latency * 1e3, 1),
+        "encode_ms": round(dt_enc * 1e3, 2),
+        "decode_ms": round(dt_dec * 1e3, 2),
+    }
+
+
+def config1_small_stripe(latency: float) -> dict:
+    """Config 1: RS k=2,m=1, 4 KiB chunks — single-stripe encode."""
+    mat = native.rs_matrix_vandermonde(2, 1)
+    chunks = np.random.default_rng(7).integers(
+        0, 256, (2, 4096), dtype=np.uint8
+    )
+    reps = 2000
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        native.rs_encode(mat, chunks)
+    host_us = (time.perf_counter() - t0) / reps * 1e6
+
+    params = datapath.ECParams(k=2, m=1, chunk_bytes=4096)
+    base = jnp.asarray(rs.pack_u32(chunks)[None])
+
+    @jax.jit
+    def enc_probe_2(b, salt):
+        _, crcs = datapath.write_step(params, b ^ salt)
+        return crcs
+
+    enc_probe = functools.partial(enc_probe_2, base)
+
+    salts = [jnp.uint32(17 * (i + 1)) for i in range(100)]
+    _sync(enc_probe(salts[0]))
+    dev_us = _timed_chain(enc_probe, salts, latency) * 1e6
+    return {
+        "host_encode_us": round(host_us, 1),
+        "device_encode_us_amortized": round(dev_us, 1),
+        "note": "latency-bound single-stripe shape; device wins by batching",
+    }
+
+
+def config4_crc32c(latency: float) -> dict:
+    """Config 4: batched crc32c over 64 KiB blobs (BlueStore csum shape).
+
+    1 M x 64 KiB = 64 GiB does not fit; throughput is measured on
+    4096-blob (256 MiB) passes — GiB/s is the scale-invariant quantity.
+    """
+    nblobs, blob = 4096, 65536
+    words = blob // 4
+    base = jax.random.bits(jax.random.key(3), (nblobs, words),
+                           dtype=jnp.uint32)
+    seed_part = np.uint32(crc_ops.zeros_shift(0xFFFFFFFF, blob))
+
+    @jax.jit
+    def crc_probe_2(b, salt):
+        return crc_ops._crc0_words(b ^ salt) ^ seed_part
+
+    crc_probe = functools.partial(crc_probe_2, base)
+
+    salts = [jnp.uint32(0x01000193 * (i + 1) & 0xFFFFFFFF)
+             for i in range(12)]
+    _sync(crc_probe(salts[0]))
+    dt = _timed_chain(crc_probe, salts, latency)
+    gibs_dev = nblobs * blob / dt / 2**30
+
+    # guard: salted stream vs the host hw-accelerated CRC
+    got0 = np.asarray(crc_probe(salts[0]))
+    blobs0 = np.ascontiguousarray(
+        np.asarray(base ^ salts[0]).astype("<u4")
+    ).view(np.uint8).reshape(nblobs, blob)
+    want = native.crc32c_batch(blobs0, threads=THREADS)
+    if not (got0 == want).all():
+        raise AssertionError("device crc32c differs from host")
+
+    t0 = time.perf_counter()
+    native.crc32c_batch(blobs0, threads=THREADS)
+    dt_host = time.perf_counter() - t0
+    gibs_host = nblobs * blob / dt_host / 2**30
+    return {
+        "device_gibs": round(gibs_dev, 2),
+        "host_gibs": round(gibs_host, 2),
+        "vs_host": round(gibs_dev / gibs_host, 2),
+    }
+
+
+def config5_straw2(latency: float) -> dict:
+    """Config 5: straw2 bulk placement over a 1 K-OSD bucket.
+
+    Throughput measured on 0.5 M objects (Mobj/s is scale-invariant; the
+    full 10 M-object run is the same kernel over more chunks). The device
+    kernel uses the gather-free one-hot LUT path (ops/crush.py); a Pallas
+    VMEM-resident variant is the planned next step.
+    """
+    n_osds, chunk, nchunks = 1000, 65536, 8
+    rng = np.random.default_rng(11)
+    items = np.arange(n_osds, dtype=np.int32)
+    weights = rng.integers(1, 4 * 0x10000, n_osds, dtype=np.uint32)
+    items_d = jnp.asarray(items)
+    weights_d = jnp.asarray(weights)
+    xs = rng.integers(0, 2**32, chunk * (nchunks + 1), dtype=np.uint32)
+    xs_d = jnp.asarray(xs)
+
+    with jax.enable_x64():
+        warm = crush_ops._jit_straw2(
+            items_d, items_d, weights_d, xs_d[:chunk], jnp.uint32(0)
+        )
+        _sync(warm[0].astype(jnp.int32) + warm[1].astype(jnp.int32))
+        t0 = time.perf_counter()
+        outs = [
+            crush_ops._jit_straw2(
+                items_d, items_d, weights_d,
+                xs_d[(i + 1) * chunk : (i + 2) * chunk], jnp.uint32(0),
+            )
+            for i in range(nchunks)
+        ]
+        acc = sum(o[0].astype(jnp.int32) for o in outs)
+        _sync(acc)
+        dt = max(time.perf_counter() - t0 - latency, 1e-9)
+    mobj_dev = nchunks * chunk / dt / 1e6
+
+    # guard + host baseline on a subset
+    sub = 100_000
+    t0 = time.perf_counter()
+    want = native.straw2_bulk(items, weights, xs[chunk : chunk + sub],
+                              threads=THREADS)
+    dt_host = time.perf_counter() - t0
+    got = np.concatenate([np.asarray(o) for o in outs[: sub // chunk + 1]])[
+        :sub
+    ]
+    if not (got == want).all():
+        raise AssertionError("device straw2 differs from host")
+    mobj_host = sub / dt_host / 1e6
+    return {
+        "device_mobj_s": round(mobj_dev, 3),
+        "host_mobj_s": round(mobj_host, 3),
+        "vs_host": round(mobj_dev / mobj_host, 2),
+        "osds": n_osds,
+    }
 
 
 def main() -> None:
-    rng = np.random.default_rng(42)
-    data_u8 = rng.integers(0, 256, (BATCH, K, CHUNK), dtype=np.uint8)
-    data = jax.device_put(rs.pack_u32(data_u8))
-
-    dt_dev, parity, decoded = device_pass(data)
-    # bit-exactness guard on one stripe before publishing a number
-    want = native.rs_encode(
-        datapath.ECParams(k=K, m=M, chunk_bytes=CHUNK).matrix, data_u8[0]
-    )
-    assert (rs.unpack_u32(parity[0]) == want).all(), "device parity mismatch"
-    assert (rs.unpack_u32(decoded[0]) == data_u8[0]).all(), "repair mismatch"
-
-    data_bytes = BATCH * K * CHUNK
-    gibs_dev = 2 * data_bytes / dt_dev / 2**30  # encode + decode passes
-
-    cpu_batch = min(BATCH, 6)
-    threads = os.cpu_count() or 1
-    dt_host = host_pass(data_u8[:cpu_batch], threads)
-    gibs_host = 2 * cpu_batch * K * CHUNK / dt_host / 2**30
-
-    print(
-        json.dumps(
-            {
-                "metric": "ec_encode_plus_2erasure_decode_k8m3_4MiB_stripes",
-                "value": round(gibs_dev, 3),
-                "unit": "GiB/s",
-                "vs_baseline": round(gibs_dev / gibs_host, 2),
-            }
-        )
-    )
+    _progress("measuring tunnel latency ...")
+    latency = measure_latency()
+    _progress(f"latency {latency*1e3:.1f} ms; headline (configs 2+3) ...")
+    result = headline(latency)
+    _progress(f"headline done: {result['value']} GiB/s")
+    result["configs"] = {}
+    for name, fn in (
+        ("1_rs_k2m1_4KiB", config1_small_stripe),
+        ("4_crc32c_64KiB_blobs", config4_crc32c),
+        ("5_straw2_1K_osds", config5_straw2),
+    ):
+        _progress(f"{name} ...")
+        result["configs"][name] = fn(latency)
+    _progress("all configs done")
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

@@ -69,14 +69,27 @@ class ErasureCode:
     def get_sub_chunk_count(self) -> int:
         return 1  # scalar codes; CLAY overrides (ErasureCodeInterface.h:259)
 
+    #: When True, get_chunk_size aligns each chunk (ISA-L style,
+    #: ErasureCodeIsa.cc:66-79); when False, the whole padded object is
+    #: aligned (jerasure style, ErasureCodeJerasure.cc:95-102).
+    per_chunk_alignment = False
+
     def get_alignment(self) -> int:
-        """Padded-object alignment; must be a multiple of 4*k so chunks
+        """Padded-object (or per-chunk) alignment. Plugins override with
+        reference-exact values (e.g. k*w*4 for jerasure matrix codes);
+        results must stay multiples of 4 (4*k object-aligned) so chunks
         pack into uint32 words for the device kernels."""
         return 4 * self.k
 
     def get_chunk_size(self, object_size: int) -> int:
-        """ErasureCodeJerasure.cc:94-101 semantics (shared alignment)."""
+        """ErasureCodeJerasure.cc:80-102 semantics, both branches."""
         alignment = self.get_alignment()
+        if self.per_chunk_alignment:
+            chunk_size = -(-object_size // self.k)
+            modulo = chunk_size % alignment
+            if modulo:
+                chunk_size += alignment - modulo
+            return chunk_size
         if alignment % self.k:
             raise ECError(f"alignment {alignment} not a multiple of k={self.k}")
         tail = object_size % alignment
@@ -120,15 +133,13 @@ class ErasureCode:
         """chunk -> [(sub_chunk_offset, count)] (ErasureCodeInterface.h:297).
 
         Indices are stored positions (like encode's output keys); scalar
-        codes always want the whole chunk: [(0, 1)].
+        codes always want the whole chunk: [(0, 1)]. The first-k-available
+        choice is made directly in stored-position space, matching
+        ErasureCode::_minimum_to_decode (ErasureCode.cc) — no generator
+        translation (decode_chunks translates internally where needed).
         """
-        want_gen = {self._position_to_generator(p) for p in want_to_read}
-        avail_gen = {self._position_to_generator(p) for p in available}
-        chosen = self._minimum_raw(want_gen, avail_gen)
-        return {
-            self.chunk_index(c): [(0, self.get_sub_chunk_count())]
-            for c in chosen
-        }
+        chosen = self._minimum_raw(set(want_to_read), set(available))
+        return {c: [(0, self.get_sub_chunk_count())] for c in chosen}
 
     def minimum_to_decode_with_cost(
         self, want_to_read: Iterable[int], available: Mapping[int, int]
@@ -181,14 +192,13 @@ class ErasureCode:
         have = set(chunks)
         if want <= have:
             return {i: _as_u8(chunks[i]) for i in sorted(want)}
-        chunks_gen = {
-            self._position_to_generator(p): _as_u8(c)
-            for p, c in chunks.items()
-        }
-        want_gen = {self._position_to_generator(p) for p in want}
-        use = self._minimum_raw(want_gen, set(chunks_gen))
+        # Fetch-set choice happens in stored-position space (same choice
+        # minimum_to_decode makes); decode_chunks works in generator space,
+        # so the chosen positions are translated at the boundary.
+        use_pos = self._minimum_raw(want, have)
+        use = [self._position_to_generator(p) for p in use_pos]
         decoded = self.decode_chunks(
-            use, np.stack([chunks_gen[i] for i in use])
+            use, np.stack([_as_u8(chunks[p]) for p in use_pos])
         )
         out: dict[int, np.ndarray] = {}
         for p in sorted(want):

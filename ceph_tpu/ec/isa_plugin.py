@@ -16,6 +16,9 @@ from .registry import register
 from .rs_plugin import RSCodec
 
 
+EC_ISA_ADDRESS_ALIGNMENT = 32  # reference isa/xor_op.h:28
+
+
 class IsaCodec(RSCodec):
     DEFAULT_TECHNIQUE = "reed_sol_van"
     _TECH_MAP = {"reed_sol_van": "reed_sol_van", "cauchy": "cauchy_orig"}
@@ -31,6 +34,13 @@ class IsaCodec(RSCodec):
         profile["technique"] = self._TECH_MAP[technique]
         super().init(profile)
         self.profile["technique"] = technique  # report the isa-facing name
+        self.profile.pop("jerasure-per-chunk-alignment", None)
+        # ISA always aligns per chunk (ErasureCodeIsa.cc:66-79), not per
+        # padded object — regardless of the jerasure-only profile flag.
+        self.per_chunk_alignment = True
+
+    def get_alignment(self) -> int:
+        return EC_ISA_ADDRESS_ALIGNMENT
 
 
 register("isa_tpu", IsaCodec)

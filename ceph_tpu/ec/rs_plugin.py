@@ -1,9 +1,18 @@
 """Reed-Solomon codec plugin ("rs_tpu") — the jerasure-role plugin.
 
 Covers the reference's matrix techniques (ErasureCodeJerasure.h:23-246):
-reed_sol_van, reed_sol_r6_op, cauchy_orig, cauchy_good. The bit-matrix
-RAID6 specializations (liberation, blaum_roth, liber8tion) are distinct
-codes, tracked as follow-ups.
+reed_sol_van, reed_sol_r6_op, cauchy_orig, cauchy_good.
+
+Wire-compatibility note: reed_sol_van and reed_sol_r6_op are byte-wise
+GF(2^8) matrix codes here exactly as in the reference, so chunk bytes
+match jerasure's output. cauchy_orig/cauchy_good use the SAME Cauchy
+generator matrices but apply them byte-wise, whereas the reference runs
+them as bitmatrix *schedule* codes with a packetsize-dependent bit-sliced
+layout (ErasureCodeJerasure.cc:261-307, jerasure_schedule_encode) — so
+identically-named cauchy profiles are NOT wire-compatible with
+reference-written shards (same erasure tolerance, different chunk bytes).
+The bit-matrix RAID6 family (liberation, blaum_roth, liber8tion) is a
+distinct code family, tracked as a follow-up.
 
 Execution backends per profile key "backend":
 - "device" (default): batched GF(2^8) SWAR kernels on TPU (ops/rs.py);
@@ -53,12 +62,16 @@ def _decode_matrix_cached(
     return gf8.decode_matrix(_matrix_for(technique, k, m), k, present)
 
 
+LARGEST_VECTOR_WORDSIZE = 16  # reference ErasureCodeJerasure.cc:30
+
+
 class RSCodec(ErasureCode):
     """Systematic RS over GF(2^8) with pluggable matrix technique."""
 
     DEFAULT_K = 7
     DEFAULT_M = 3
     DEFAULT_TECHNIQUE = "reed_sol_van"
+    W = 8
 
     def init(self, profile) -> None:
         super().init(profile)
@@ -79,8 +92,20 @@ class RSCodec(ErasureCode):
         self.backend = self.profile.get("backend", "device")
         if self.backend not in ("device", "host"):
             raise ECError(f"backend must be device|host, not {self.backend!r}")
+        self.per_chunk_alignment = self.to_bool(
+            "jerasure-per-chunk-alignment", False
+        )
         self.matrix = _matrix_for(self.technique, self.k, self.m)
         self._parse_mapping()
+
+    def get_alignment(self) -> int:
+        """Reference-exact (ErasureCodeJerasure.cc:174-184 for the matrix
+        techniques; our byte-wise cauchy shares the matrix-RS layout, see
+        module docstring): w=8 makes (w*4) % 16 == 0, so the shared branch
+        is k*w*sizeof(int) = 32k and per-chunk is w*16 = 128."""
+        if self.per_chunk_alignment:
+            return self.W * LARGEST_VECTOR_WORDSIZE
+        return self.k * self.W * 4
 
     # ----------------------------------------------------- byte interface
 

@@ -110,13 +110,57 @@ def hash32_3(a: jax.Array, b: jax.Array, c: jax.Array) -> jax.Array:
 # ---------------------------------------------------------------- crush_ln
 
 
+#: None = auto (gather on CPU where it is fast, one-hot elsewhere);
+#: True/False forces a path (tests pin both paths equal).
+LUT_USE_GATHER: bool | None = None
+
+
+def _use_gather_luts() -> bool:
+    if LUT_USE_GATHER is not None:
+        return LUT_USE_GATHER
+    return jax.default_backend() == "cpu"
+
+
+def _lut_nogather(idx: jax.Array, *tables: np.ndarray) -> list[jax.Array]:
+    """Bit-exact small-table lookups without gathers.
+
+    TPU vector units have no gather instruction, so jnp.take from even a
+    129-entry table serializes (measured ~70x slowdown of the whole straw2
+    kernel). Instead: one-hot compare against an iota, multiply-accumulate
+    the table values split into 17-bit limbs in f32 (a one-hot sum selects
+    exactly one term, and ints < 2^24 are exact in f32, so the result is
+    bit-exact). The (..., T) one-hot never materializes in HBM — XLA fuses
+    compare -> mul -> reduce into one elementwise pass; multiple tables
+    share the same one-hot. Values must be non-negative and < 2^51.
+    """
+    iota = jnp.arange(len(tables[0]), dtype=jnp.int32)
+    onehot = (idx[..., None] == iota).astype(jnp.float32)
+    outs = []
+    for tbl in tables:
+        t = np.asarray(tbl, dtype=np.int64)
+        assert t.shape == tables[0].shape
+        assert (t >= 0).all() and int(t.max()) < (1 << 51), "limb overflow"
+        val = None
+        for j in range(3):
+            limb = ((t >> (17 * j)) & 0x1FFFF).astype(np.float32)
+            if not limb.any():
+                continue
+            part = jnp.sum(onehot * jnp.asarray(limb), axis=-1)
+            part = part.astype(_I64) << _I64(17 * j)
+            val = part if val is None else val + part
+        outs.append(val if val is not None else jnp.zeros(idx.shape, _I64))
+    return outs
+
+
 @_x64
 def crush_ln(u: jax.Array) -> jax.Array:
     """2^44 * log2(x+1) in 16.44 fixed point (mapper.c:226), elementwise.
 
     u is the 16-bit hash value (hash & 0xffff); returns int64. Matches
     ct_crush_ln bit-for-bit, including the x == 0x10000 int64-wraparound
-    quirk of the reference.
+    quirk of the reference. Table lookups use the gather-free one-hot
+    reduction (_lut_nogather) — the straw2 hot path is gather-bound
+    otherwise.
     """
     rh_t, lh_t, ll_t = _ln_tables()
     x = (u.astype(_U32) & _U32(0xFFFF)) + _U32(1)  # 1..0x10000
@@ -129,12 +173,18 @@ def crush_ln(u: jax.Array) -> jax.Array:
     xs = x << shift
     iexpon = jnp.where(big, 15, hb).astype(_I64)
     idx1 = (jax.lax.shift_right_logical(xs, _U32(8)) - _U32(128)).astype(jnp.int32)
-    rh = jnp.asarray(rh_t)[idx1]
-    lh = jnp.asarray(lh_t)[idx1]
+    if _use_gather_luts():
+        rh = jnp.asarray(rh_t)[idx1]
+        lh = jnp.asarray(lh_t)[idx1]
+    else:
+        rh, lh = _lut_nogather(idx1, rh_t, lh_t)
     # (int64)x * RH can wrap at x == 0x10000 — intentional, matches C.
     xl64 = (xs.astype(_I64) * rh) >> _I64(48)
     idx2 = (xl64 & _I64(0xFF)).astype(jnp.int32)
-    ll = jnp.asarray(ll_t)[idx2]
+    if _use_gather_luts():
+        ll = jnp.asarray(ll_t)[idx2]
+    else:
+        (ll,) = _lut_nogather(idx2, ll_t)
     return (iexpon << _I64(44)) + ((lh + ll) >> _I64(4))
 
 

@@ -16,8 +16,14 @@ same semantics as masked fixed-shape iteration over an entire batch:
 Scope (v1): straw2 buckets, jewel-era tunables with
 choose_local_tries == choose_local_fallback_tries == 0 (their defaults
 since 2014), rules shaped take -> [set_*] -> choose|chooseleaf -> emit —
-the shape of every rule Ceph's own tooling generates. Anything else
-falls back to the host oracle (CrushMap.do_rule) transparently.
+the shape of every rule Ceph's own tooling generates. Unsupported maps,
+tunables, or rule shapes are REJECTED with ValueError at compile time
+(callers route those through the host oracle, CrushMap.do_rule); nothing
+silently degrades. compile_rule also rejects maps where a device item
+sits above the choose-type level (e.g. a root holding both hosts and
+bare OSDs): the C handles that case with skip_rep/ITEM_NONE semantics
+(mapper.c:497-516) that the fixed-shape descent does not reproduce, so
+such maps must use the host engine rather than silently diverge.
 
 Bit-exactness is asserted in tests against the host engine, which is
 itself verified against the compiled reference C (test_placement.py).
@@ -91,6 +97,35 @@ class CompiledMap:
 
         return max(d(bid) for bid in self.crushmap.buckets)
 
+    def _validate_descent(self, take: int, choose_type: int) -> None:
+        """Reject maps where a device item is chooseable above the
+        choose-type level. The C handles such picks with skip_rep (firstn,
+        mapper.c:497) or ITEM_NONE (indep, mapper.c:516), altering the r
+        retry sequence in ways the fixed-shape descent does not reproduce
+        — so the asserted bit-exactness contract would silently break.
+        Those maps must use the host oracle."""
+        if choose_type == 0:
+            return  # devices are the targets; any item is a valid stop
+        stack = [take]
+        seen: set[int] = set()
+        while stack:
+            bid = stack.pop()
+            if bid in seen or bid >= 0:
+                continue
+            seen.add(bid)
+            b = self.crushmap.buckets[bid]
+            for it in b.items:
+                it_type = 0 if it >= 0 else self.crushmap.buckets[it].type_id
+                if it_type == choose_type:
+                    continue  # valid descent target; recursion stops here
+                if it >= 0:
+                    raise ValueError(
+                        f"device engine: bucket {bid} (type {b.type_id}) "
+                        f"holds device {it} above choose type "
+                        f"{choose_type}; use the host oracle for this map"
+                    )
+                stack.append(it)
+
     def compile_rule(self, ruleno: int, result_max: int) -> CompiledRule:
         """Validate + flatten a take/set*/choose/emit rule."""
         t = self.tunables
@@ -126,6 +161,12 @@ class CompiledMap:
                 raise ValueError(f"device engine: unsupported op {s.op}")
         if take is None or choose is None or not seen_emit:
             raise ValueError("device engine: rule must take/choose/emit")
+        if take >= 0 or take not in self.crushmap.buckets:
+            raise ValueError(
+                f"device engine: take target {take} is not a bucket; "
+                "use the host oracle"
+            )
+        self._validate_descent(take, choose.arg2)
         firstn = choose.op in (cm.OP_CHOOSE_FIRSTN, cm.OP_CHOOSELEAF_FIRSTN)
         if firstn:
             if choose_leaf_tries:

@@ -138,6 +138,7 @@ class OSDMap:
         self.pools: dict[int, Pool] = {}
         self.pg_upmap: dict[tuple[int, int], list[int]] = {}
         self.pg_upmap_items: dict[tuple[int, int], list[tuple[int, int]]] = {}
+        self.pg_upmap_primaries: dict[tuple[int, int], int] = {}
         self._out_weights_cache: np.ndarray | None = None
 
     # ------------------------------------------------------------- state
@@ -189,31 +190,53 @@ class OSDMap:
         )
         return raw, pps
 
+    def _osd_marked_out(self, osd: int) -> bool:
+        """The reference's upmap validity predicate (OSDMap.cc:2674-2677):
+        reject only a target that is a valid in-range osd id with
+        osd_weight == 0; out-of-range and NONE targets pass through."""
+        return (
+            osd != ITEM_NONE
+            and 0 <= osd < self.n_osds
+            and int(self.out_weights()[osd]) == 0
+        )
+
     def _apply_upmap(self, pool: Pool, pgid: tuple[int, int], raw: list[int]):
-        """OSDMap::_apply_upmap (OSDMap.cc:2668): a valid full pg_upmap
-        replaces raw and pg_upmap_items are STILL applied on top; an
-        invalid pg_upmap (any target out/oob) returns raw untouched,
-        skipping items too — matching the reference's early return."""
+        """OSDMap::_apply_upmap (OSDMap.cc:2668-2730): a valid full
+        pg_upmap replaces raw and pg_upmap_items are STILL applied on top;
+        an invalid pg_upmap (any in-range target with weight 0) returns
+        raw untouched, skipping items and primaries too — matching the
+        reference's early return."""
         out = list(raw)
         pm = self.pg_upmap.get(pgid)
         if pm:
-            for o in pm:
-                if o == ITEM_NONE:
-                    continue
-                if not (0 <= o < self.n_osds) or self.osds[o].weight == 0:
-                    return out  # reject whole override, skip items
+            if any(self._osd_marked_out(o) for o in pm):
+                return out  # reject whole override, skip items/primaries
             out = list(pm)
         for frm, to in self.pg_upmap_items.get(pgid, []):
-            if (
-                not (0 <= to < self.n_osds)
-                or not self.osds[to].exists
-                or self.osds[to].weight == 0
-                or to in out
-            ):
-                continue
+            # One scan per pair, faithful to the reference loop: `to`
+            # already present anywhere kills the pair; `frm` is replaced
+            # at its first position unless `to` is marked out.
+            exists = False
+            pos = -1
             for i, o in enumerate(out):
-                if o == frm:
-                    out[i] = to
+                if o == to:
+                    exists = True
+                    break
+                if o == frm and pos < 0 and not self._osd_marked_out(to):
+                    pos = i
+            if not exists and pos >= 0:
+                out[pos] = to
+        new_prim = self.pg_upmap_primaries.get(pgid)
+        if (
+            new_prim is not None
+            and new_prim != ITEM_NONE
+            and 0 <= new_prim < self.n_osds
+            and int(self.out_weights()[new_prim]) != 0
+        ):
+            for i in range(1, len(out)):  # start from 1 on purpose
+                if out[i] == new_prim:
+                    out[i] = out[0]
+                    out[0] = new_prim
                     break
         return out
 
@@ -272,6 +295,11 @@ class OSDMap:
                 self.pg_upmap_items[pgid] = items
             else:
                 self.pg_upmap_items.pop(pgid, None)
+        for pgid, prim in inc.new_pg_upmap_primaries.items():
+            if prim is not None and prim != -1:
+                self.pg_upmap_primaries[pgid] = prim
+            else:
+                self.pg_upmap_primaries.pop(pgid, None)
         self._out_weights_cache = None
         self.epoch = inc.epoch
 
@@ -287,5 +315,9 @@ class Incremental:
     new_pools: list[Pool] = field(default_factory=list)
     new_pg_upmap: dict[tuple[int, int], list[int]] = field(default_factory=dict)
     new_pg_upmap_items: dict[tuple[int, int], list[tuple[int, int]]] = field(
+        default_factory=dict
+    )
+    # pgid -> osd (None or -1 removes the mapping)
+    new_pg_upmap_primaries: dict[tuple[int, int], int | None] = field(
         default_factory=dict
     )

@@ -145,7 +145,7 @@ def test_nonstable_tunables():
     _check(m, 0, 3, n_x=256)
 
 
-def test_unsupported_falls_back():
+def test_unsupported_rejected():
     m = cm.build_flat(4, alg=cm.ALG_UNIFORM)
     with pytest.raises(ValueError):
         bulk.CompiledMap(m)
@@ -165,3 +165,29 @@ def test_chunked_dispatch_consistency():
     a = bulk.do_rule_bulk(comp, 0, xs, 3, chunk=128)
     b = bulk.do_rule_bulk(comp, 0, xs, 3, chunk=1 << 18)
     np.testing.assert_array_equal(a, b)
+
+
+def test_device_above_choose_type_rejected():
+    """A root holding both hosts and bare OSDs diverges from the C's
+    skip_rep/ITEM_NONE semantics (mapper.c:497-516), so compile_rule must
+    reject it rather than silently produce different placements."""
+    m = cm.build_hierarchy(osds_per_host=2, n_hosts=2)
+    root = next(b for b in m.buckets.values() if b.type_id == 2)
+    root.items.append(99)  # bare OSD directly under the root
+    root.weights.append(0x10000)
+    m.max_devices = max(m.max_devices, 100)
+    m.add_rule(cm.replicated_rule(0, root=root.id, failure_domain_type=1))
+    comp = bulk.CompiledMap(m)
+    with pytest.raises(ValueError, match="above choose type"):
+        comp.compile_rule(0, 3)
+
+
+def test_take_device_rejected():
+    m = cm.build_flat(4)
+    m.add_rule(cm.Rule(0, [
+        cm.Step(cm.OP_TAKE, 2),  # a device, not a bucket
+        cm.Step(cm.OP_CHOOSELEAF_FIRSTN, 0, 1),
+        cm.Step(cm.OP_EMIT),
+    ]))
+    with pytest.raises(ValueError, match="not a bucket"):
+        bulk.CompiledMap(m).compile_rule(0, 3)

@@ -73,3 +73,24 @@ def test_x64_does_not_leak_default_dtypes():
     import jax.numpy as jnp
 
     assert jnp.asarray(np.zeros(3, np.uint32)).dtype == jnp.uint32
+
+
+def test_lut_nogather_bit_exact():
+    """The TPU gather-free LUT path equals the gather path (and thus the
+    C host core) for every 16-bit input."""
+    import jax
+    import jax.numpy as jnp
+
+    from ceph_tpu.ops import crush as crush_ops
+
+    u = jnp.asarray(np.arange(65536, dtype=np.uint32))
+    try:
+        crush_ops.LUT_USE_GATHER = False
+        with jax.enable_x64():
+            nogather = np.asarray(jax.jit(crush_ops.crush_ln)(u))
+        crush_ops.LUT_USE_GATHER = True
+        with jax.enable_x64():
+            gather = np.asarray(jax.jit(crush_ops.crush_ln)(u))
+    finally:
+        crush_ops.LUT_USE_GATHER = None
+    np.testing.assert_array_equal(nogather, gather)

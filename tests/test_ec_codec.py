@@ -155,3 +155,49 @@ def test_mapping_validation():
         make(k=3, m=1, mapping="DDDD_")  # wrong length
     with pytest.raises(ec.ECError):
         make(k=3, m=1, mapping="DD__")  # wrong D count
+
+
+def test_alignment_reference_semantics():
+    """get_alignment/get_chunk_size match the reference formulas
+    (ErasureCodeJerasure.cc:174-184, ErasureCodeIsa.cc:66-79)."""
+    c = make(k=8, m=3)
+    assert c.get_alignment() == 8 * 8 * 4  # k*w*sizeof(int), w=8
+    # object padded to alignment, chunk = padded/k
+    assert c.get_chunk_size(1) == 256 // 8
+    assert c.get_chunk_size(8 * 32) == 32
+    assert c.get_chunk_size(8 * 32 + 1) == 64
+
+    pc = make(k=8, m=3, **{"jerasure-per-chunk-alignment": "true"})
+    assert pc.get_alignment() == 8 * 16  # w * LARGEST_VECTOR_WORDSIZE
+    assert pc.get_chunk_size(1) == 128  # ceil(1/8) -> pad to 128
+    assert pc.get_chunk_size(8 * 128 + 1) == 256
+
+    isa = make(plugin="isa_tpu", k=7, m=3)
+    assert isa.get_alignment() == 32  # EC_ISA_ADDRESS_ALIGNMENT
+    assert isa.get_chunk_size(7 * 32) == 32
+    assert isa.get_chunk_size(7 * 32 + 1) == 64  # ceil(225/7)=33 -> 64
+
+
+def test_minimum_to_decode_raw_position_space():
+    """With a non-trivial mapping the fetch set is chosen among stored
+    positions directly (ErasureCode::_minimum_to_decode semantics), not
+    translated through generator space first."""
+    # k=3, m=2: mapping puts coding chunks at positions 0,2 and data at
+    # 1,3,4 (mapping chars: non-D = coding).
+    c = make(k=3, m=2, mapping="_D_DD")
+    # data (generator 0,1,2) live at positions 1,3,4; coding at 0,2
+    assert c.get_chunk_mapping() == [1, 3, 4, 0, 2]
+    # want position 1 but it is missing; available positions 0,2,3,4:
+    # reference picks the first k=3 of sorted available -> {0, 2, 3}
+    got = c.minimum_to_decode([1], [0, 2, 3, 4])
+    assert set(got) == {0, 2, 3}
+    # decode using exactly that set must reproduce the missing chunk
+    data = np.arange(3 * 64, dtype=np.uint8).tobytes()
+    enc = c.encode(range(5), data)
+    dec = c.decode([1], {p: enc[p] for p in got})
+    np.testing.assert_array_equal(dec[1], enc[1])
+    # consistency: with_cost picks in the same space
+    got_cost = c.minimum_to_decode_with_cost(
+        [1], {p: 1 for p in [0, 2, 3, 4]}
+    )
+    assert set(got_cost) == {0, 2, 3}

@@ -441,3 +441,68 @@ def test_upmap_full_plus_items_compose():
     osdm.pg_upmap_items[pgid] = [(up0[0], free[2])]
     up2, _ = osdm.pg_to_up_acting_osds(pgid)
     assert up2 == up0
+
+
+def _flat_map_for_upmap(n=6):
+    import ceph_tpu.placement.crushmap as cm
+    from ceph_tpu.placement.osdmap import OSDMap, Pool
+
+    m = cm.build_flat(n)
+    m.add_rule(cm.flat_firstn_rule(0))
+    om = OSDMap(m, n)
+    om.add_pool(Pool(id=1, name="p", size=3, pg_num=8, crush_rule=0))
+    return om
+
+
+def test_upmap_validity_predicate_matches_reference():
+    """OSDMap.cc:2674-2677: reject only in-range weight-0 targets;
+    out-of-range targets pass through and get applied."""
+    om = _flat_map_for_upmap()
+    pgid = (1, 3)
+    raw, _ = om.pg_to_raw_osds(pgid)
+
+    # in-range but marked out (weight 0) -> whole pg_upmap rejected
+    om.osds[5].weight = 0
+    om._out_weights_cache = None
+    om.pg_upmap[pgid] = [5, 0, 1]
+    assert om._apply_upmap(om.pools[1], pgid, raw) == raw
+
+    # out-of-range target passes the predicate and is applied verbatim
+    om.pg_upmap[pgid] = [97, 0, 1]
+    assert om._apply_upmap(om.pools[1], pgid, raw) == [97, 0, 1]
+
+    # items: marked-out target skipped, oob target applied
+    del om.pg_upmap[pgid]
+    om.pg_upmap_items[pgid] = [(raw[0], 5)]  # 5 has weight 0 -> skip
+    assert om._apply_upmap(om.pools[1], pgid, raw) == raw
+    om.pg_upmap_items[pgid] = [(raw[0], 98)]
+    got = om._apply_upmap(om.pools[1], pgid, raw)
+    assert got[0] == 98 and got[1:] == raw[1:]
+    # target already present anywhere -> pair ignored
+    om.pg_upmap_items[pgid] = [(raw[0], raw[1])]
+    assert om._apply_upmap(om.pools[1], pgid, raw) == raw
+
+
+def test_pg_upmap_primaries():
+    """OSDMap.cc:2712-2730: valid new primary swaps to front; marked-out
+    or absent primaries leave the set untouched."""
+    om = _flat_map_for_upmap()
+    pgid = (1, 2)
+    raw, _ = om.pg_to_raw_osds(pgid)
+    assert len(raw) == 3
+
+    om.pg_upmap_primaries[pgid] = raw[2]
+    got = om._apply_upmap(om.pools[1], pgid, raw)
+    assert got[0] == raw[2] and got[1] == raw[1] and got[2] == raw[0]
+
+    # marked out -> not applied
+    om.osds[raw[2]].weight = 0
+    om._out_weights_cache = None
+    assert om._apply_upmap(om.pools[1], pgid, raw) == raw
+
+    # not in the set -> not applied
+    om.osds[raw[2]].weight = 0x10000
+    om._out_weights_cache = None
+    other = next(o for o in range(6) if o not in raw)
+    om.pg_upmap_primaries[pgid] = other
+    assert om._apply_upmap(om.pools[1], pgid, raw) == raw
