@@ -1,0 +1,22 @@
+"""RADOS-role cluster core: mon-lite, OSD-lite, client, recovery.
+
+The reference's L3+L4 (SURVEY.md §1): the OSD daemon executing PG ops
+over a PGBackend (src/osd/OSD.cc, PrimaryLogPG.cc, ReplicatedBackend.cc,
+ECBackend.cc), the mon as map authority (src/mon), and the client-side
+Objecter (src/osdc/Objecter.cc) — rebuilt as asyncio single-reactor
+daemons (the Crimson stance) around the existing TPU-first kernels:
+
+- placement: ceph_tpu.placement (CRUSH/OSDMap — unchanged seam)
+- codec: ceph_tpu.ec plugins; EC writes batch stripes to the device
+- store: ceph_tpu.store (MemStore; durable stores plug into the same
+  ObjectStore contract)
+- wire: ceph_tpu.msg (CRC-framed typed messages over LocalBus or TCP)
+
+Everything runs equally over the in-process LocalBus (cluster-free test
+tiers, SURVEY §4.2) or TCP (vstart-style multi-process).
+"""
+from .messages import *  # noqa: F401,F403
+from .mon import MonLite  # noqa: F401
+from .osd import OSDLite  # noqa: F401
+from .client import RadosClient  # noqa: F401
+from .vstart import TestCluster  # noqa: F401

@@ -1,0 +1,179 @@
+"""RadosClient: librados-role API over an Objecter-role engine.
+
+calc_target maps object -> PG -> primary through the client's own OSDMap
+copy (Objecter::_calc_target, src/osdc/Objecter.cc:2776); ops are
+tracked in-flight and resent when the map changes their target or when
+the primary answers ESTALE (the resend-on-epoch-change contract,
+Objecter.cc:2384). Public surface mirrors IoCtx basics: create_pool,
+write_full, read, stat, delete (src/librados/IoCtxImpl.cc:589-668).
+"""
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass, field
+
+from ..placement import encoding as menc
+from ..placement.osdmap import Pool
+from . import messages as M
+
+
+@dataclass
+class _InFlight:
+    msg: M.MOSDOp
+    fut: asyncio.Future
+    target: int = -1
+    attempts: int = 0
+
+
+class RadosClient:
+    def __init__(self, bus, name: str = "client.0",
+                 op_timeout: float = 10.0):
+        self.bus = bus
+        self.name = name
+        self.osdmap = None
+        self.op_timeout = op_timeout
+        self._tid = 0
+        self._ops: dict[int, _InFlight] = {}
+        self._pools: dict[str, int] = {}
+        self._map_waiters: list[asyncio.Future] = []
+
+    # ---------------------------------------------------------- lifecycle
+
+    async def connect(self) -> None:
+        self.bus.register(self.name, self.handle)
+        await self.bus.send(self.name, "mon", M.MMonSubscribe(what="osdmap"))
+        await self._wait_for_map()
+
+    async def close(self) -> None:
+        self.bus.unregister(self.name)
+
+    async def _wait_for_map(self) -> None:
+        while self.osdmap is None:
+            fut = asyncio.get_running_loop().create_future()
+            self._map_waiters.append(fut)
+            await asyncio.wait_for(fut, self.op_timeout)
+
+    # ------------------------------------------------------------ dispatch
+
+    async def handle(self, src: str, msg) -> None:
+        if isinstance(msg, M.MOSDMapMsg):
+            self._apply_map(msg)
+        elif isinstance(msg, M.MOSDOpReply):
+            await self._handle_reply(msg)
+        elif isinstance(msg, M.MPoolCreateReply):
+            self._pools["_last"] = msg.pool_id
+            for fut in self._map_waiters:
+                if not fut.done():
+                    fut.set_result(None)
+
+    def _apply_map(self, msg: M.MOSDMapMsg) -> None:
+        if msg.full:
+            self.osdmap, _ = menc.decode_osdmap(msg.full)
+        for raw in msg.incrementals:
+            inc, _ = menc.decode_incremental(raw)
+            if self.osdmap is None:
+                return
+            if inc.epoch == self.osdmap.epoch + 1:
+                self.osdmap.apply_incremental(inc)
+        for fut in self._map_waiters:
+            if not fut.done():
+                fut.set_result(None)
+        self._map_waiters = [f for f in self._map_waiters if not f.done()]
+        # resend ops whose target moved (Objecter resend-on-map-change)
+        for op in list(self._ops.values()):
+            new_target = self._calc_target(op.msg.pgid)
+            if new_target != op.target and new_target >= 0:
+                op.target = new_target
+                op.msg.epoch = self.osdmap.epoch
+                asyncio.get_running_loop().create_task(
+                    self._send_op(op)
+                )
+
+    async def _handle_reply(self, msg: M.MOSDOpReply) -> None:
+        op = self._ops.get(msg.tid)
+        if op is None:
+            return
+        if msg.result == M.ESTALE or msg.result == M.EAGAIN:
+            # refresh the map, recalc, resend (with a retry cap)
+            op.attempts += 1
+            if op.attempts > 20:
+                del self._ops[msg.tid]
+                if not op.fut.done():
+                    op.fut.set_exception(
+                        IOError(f"op {msg.tid} failed after retries")
+                    )
+                return
+            await self.bus.send(
+                self.name, "mon",
+                M.MMonGetMap(have=self.osdmap.epoch if self.osdmap else 0),
+            )
+            await asyncio.sleep(0.05 * min(op.attempts, 10))
+            op.target = self._calc_target(op.msg.pgid)
+            if op.target >= 0:
+                op.msg.epoch = self.osdmap.epoch
+                await self._send_op(op)
+            return
+        del self._ops[msg.tid]
+        if not op.fut.done():
+            op.fut.set_result(msg)
+
+    # ------------------------------------------------------------- engine
+
+    def _calc_target(self, pgid) -> int:
+        _up, primary = self.osdmap.pg_to_up_acting_osds(pgid)
+        return primary
+
+    async def _send_op(self, op: _InFlight) -> None:
+        try:
+            await self.bus.send(self.name, f"osd.{op.target}", op.msg)
+        except Exception:
+            pass  # wait for a map change to resend
+
+    async def _submit(self, pool_id: int, name: str | bytes, opname: str,
+                      data: bytes = b"", offset: int = 0,
+                      length: int = -1) -> M.MOSDOpReply:
+        oid = name.encode() if isinstance(name, str) else bytes(name)
+        pgid = self.osdmap.object_to_pg(pool_id, oid)
+        self._tid += 1
+        msg = M.MOSDOp(tid=self._tid, pgid=pgid, oid=oid, op=opname,
+                       offset=offset, length=length, data=data,
+                       epoch=self.osdmap.epoch)
+        op = _InFlight(msg=msg, fut=asyncio.get_running_loop()
+                       .create_future())
+        self._ops[self._tid] = op
+        op.target = self._calc_target(pgid)
+        if op.target >= 0:
+            await self._send_op(op)
+        reply = await asyncio.wait_for(op.fut, self.op_timeout)
+        if reply.result != M.OK:
+            if reply.result == M.ENOENT:
+                raise KeyError(name)
+            raise IOError(f"{opname} failed: {reply.result}")
+        return reply
+
+    # ------------------------------------------------------------ surface
+
+    async def create_pool(self, pool: Pool) -> int:
+        fut = asyncio.get_running_loop().create_future()
+        self._map_waiters.append(fut)
+        await self.bus.send(
+            self.name, "mon", M.MPoolCreate(pool=menc._enc_pool(pool))
+        )
+        await asyncio.wait_for(fut, self.op_timeout)
+        return self._pools.get("_last", pool.id)
+
+    async def write_full(self, pool_id: int, name, data: bytes) -> None:
+        await self._submit(pool_id, name, "writefull", data=bytes(data))
+
+    async def read(self, pool_id: int, name, offset: int = 0,
+                   length: int = -1) -> bytes:
+        reply = await self._submit(pool_id, name, "read", offset=offset,
+                                   length=length)
+        return reply.data
+
+    async def stat(self, pool_id: int, name) -> int:
+        reply = await self._submit(pool_id, name, "stat")
+        return reply.size
+
+    async def delete(self, pool_id: int, name) -> None:
+        await self._submit(pool_id, name, "delete")

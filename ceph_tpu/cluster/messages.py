@@ -1,0 +1,234 @@
+"""Concrete cluster messages (the src/messages/ role).
+
+Field kinds are declarative (msg/messages.py); every message round-trips
+through denc and rides a CRC32C frame. pgid is (pool i32, ps u32);
+eversion is (epoch u32, seq u64) — ordering matches the reference's
+eversion_t (version_t dominates within an epoch).
+"""
+from __future__ import annotations
+
+from ..msg.messages import Message, register_message
+
+PGID = "pair:i32:u32"
+EVERSION = "pair:u32:u64"
+
+# op result codes (negated errno style, like the reference)
+OK = 0
+ENOENT = -2
+EAGAIN = -11
+ESTALE = -116
+
+
+# ------------------------------------------------------------------- mon
+
+
+@register_message
+class MOSDBoot(Message):
+    TYPE = 10
+    FIELDS = (("osd", "u32"),)
+
+
+@register_message
+class MMonGetMap(Message):
+    TYPE = 11
+    FIELDS = (("have", "u32"),)  # epoch already held; 0 = send full
+
+
+@register_message
+class MOSDMapMsg(Message):
+    TYPE = 12
+    # full map bytes (empty if only incrementals), then incrementals in
+    # epoch order; receiver applies what it can and re-requests on gaps
+    FIELDS = (("full", "bytes"), ("incrementals", "list:bytes"),
+              ("epoch", "u32"))
+
+
+@register_message
+class MPing(Message):
+    TYPE = 13
+    FIELDS = (("osd", "u32"), ("epoch", "u32"))
+
+
+@register_message
+class MMonSubscribe(Message):
+    TYPE = 14
+    FIELDS = (("what", "str"),)
+
+
+@register_message
+class MFailure(Message):
+    TYPE = 15
+    FIELDS = (("target", "u32"), ("reporter", "str"))
+
+
+@register_message
+class MPoolCreate(Message):
+    TYPE = 16
+    # pool spec shipped as an encoded Pool (placement/encoding._enc_pool)
+    FIELDS = (("pool", "bytes"),)
+
+
+@register_message
+class MPoolCreateReply(Message):
+    TYPE = 17
+    FIELDS = (("pool_id", "i32"), ("epoch", "u32"))
+
+
+# ---------------------------------------------------------- client <-> osd
+
+
+@register_message
+class MOSDOp(Message):
+    TYPE = 20
+    FIELDS = (
+        ("tid", "u64"),
+        ("pgid", PGID),
+        ("oid", "bytes"),
+        ("op", "str"),  # writefull | read | delete | stat
+        ("offset", "u64"),
+        ("length", "i64"),  # -1 = to end (read)
+        ("data", "bytes"),
+        ("epoch", "u32"),  # client's map epoch at send time
+    )
+
+
+@register_message
+class MOSDOpReply(Message):
+    TYPE = 21
+    FIELDS = (
+        ("tid", "u64"),
+        ("result", "i32"),
+        ("data", "bytes"),
+        ("size", "u64"),
+        ("epoch", "u32"),  # responder's epoch (client refreshes on ESTALE)
+    )
+
+
+# ------------------------------------------------------------- osd <-> osd
+
+
+@register_message
+class MOSDRepOp(Message):
+    TYPE = 30
+    FIELDS = (
+        ("tid", "u64"),
+        ("pgid", PGID),
+        ("txn", "bytes"),  # encoded store Transaction
+        ("entry", "bytes"),  # encoded PGLog entry
+        ("epoch", "u32"),
+    )
+
+
+@register_message
+class MOSDRepOpReply(Message):
+    TYPE = 31
+    FIELDS = (("tid", "u64"), ("pgid", PGID), ("result", "i32"),
+              ("osd", "u32"))
+
+
+@register_message
+class MECSubWrite(Message):
+    TYPE = 32
+    FIELDS = (
+        ("tid", "u64"),
+        ("pgid", PGID),
+        ("shard", "u32"),
+        ("txn", "bytes"),
+        ("entry", "bytes"),
+        ("epoch", "u32"),
+    )
+
+
+@register_message
+class MECSubWriteReply(Message):
+    TYPE = 33
+    FIELDS = (("tid", "u64"), ("pgid", PGID), ("shard", "u32"),
+              ("result", "i32"))
+
+
+@register_message
+class MECSubRead(Message):
+    TYPE = 34
+    FIELDS = (
+        ("tid", "u64"),
+        ("pgid", PGID),
+        ("shard", "u32"),
+        ("oid", "bytes"),
+        ("offset", "u64"),
+        ("length", "i64"),
+    )
+
+
+@register_message
+class MECSubReadReply(Message):
+    TYPE = 35
+    FIELDS = (
+        ("tid", "u64"),
+        ("pgid", PGID),
+        ("shard", "u32"),
+        ("result", "i32"),
+        ("data", "bytes"),
+        ("digest", "u32"),  # stored hinfo crc for the returned chunk
+        ("size", "u64"),  # stored whole-object size attr
+    )
+
+
+# ---------------------------------------------------------------- peering
+
+
+@register_message
+class MPGInfoReq(Message):
+    TYPE = 40
+    FIELDS = (("pgid", PGID), ("epoch", "u32"), ("shard", "i32"))
+
+
+@register_message
+class MPGInfoReply(Message):
+    TYPE = 41
+    FIELDS = (("pgid", PGID), ("epoch", "u32"), ("shard", "i32"),
+              ("info", "bytes"))  # encoded PGInfo (pglog.py)
+
+
+@register_message
+class MPushOp(Message):
+    TYPE = 42
+    FIELDS = (
+        ("pgid", PGID),
+        ("shard", "i32"),
+        ("oid", "bytes"),
+        ("version", EVERSION),
+        ("data", "bytes"),
+        ("attrs", "map:str:bytes"),
+        ("epoch", "u32"),
+        ("last_update", EVERSION),  # pushes end with the log point covered
+    )
+
+
+@register_message
+class MPushReply(Message):
+    TYPE = 43
+    FIELDS = (("pgid", PGID), ("shard", "i32"), ("oid", "bytes"),
+              ("result", "i32"))
+
+
+@register_message
+class MPull(Message):
+    TYPE = 44
+    # "send me your copy of oid" — the puller recovers itself (the
+    # reference's PullOp role); answered with MPushOp
+    FIELDS = (("pgid", PGID), ("shard", "i32"), ("oid", "bytes"),
+              ("epoch", "u32"))
+
+
+@register_message
+class MPGScan(Message):
+    TYPE = 45
+    # backfill enumeration: "list your objects + versions"
+    FIELDS = (("pgid", PGID), ("shard", "i32"), ("epoch", "u32"))
+
+
+@register_message
+class MPGScanReply(Message):
+    TYPE = 46
+    FIELDS = (("pgid", PGID), ("shard", "i32"),
+              ("objects", "map:bytes:" + EVERSION))

@@ -1,0 +1,197 @@
+"""MonLite: the cluster-map authority (src/mon role, single-node form).
+
+Owns the OSDMap, admits OSDs (MOSDBoot — OSDMonitor::preprocess_boot
+role), detects failures by heartbeat timeout plus peer failure reports
+(OSDMonitor::prepare_failure, OSDMonitor.cc:3325), marks down OSDs out
+after an interval (mon_osd_down_out_interval), creates pools, and
+publishes epochs as incrementals to subscribers.
+
+Single-authority by design for now: the reference replicates this state
+machine over Paxos (src/mon/Paxos.cc:154-890) for mon fault tolerance;
+the map-mutation protocol here is already incremental-epoch shaped, so a
+consensus layer slots under commit() without touching callers. Tracked
+as the consensus follow-up (SURVEY §2.5).
+"""
+from __future__ import annotations
+
+import asyncio
+import time
+
+from ..placement import crushmap as cm
+from ..placement import encoding as menc
+from ..placement.osdmap import Incremental, OSDMap
+from . import messages as M
+
+
+class MonLite:
+    def __init__(
+        self,
+        bus,
+        n_osds: int,
+        crush: cm.CrushMap | None = None,
+        hb_grace: float = 1.0,
+        out_interval: float = 5.0,
+        name: str = "mon",
+    ):
+        if crush is None:
+            crush = cm.build_flat(n_osds)
+            crush.add_rule(cm.flat_firstn_rule(0))
+            crush.add_rule(cm.ec_rule(1, root=-1, failure_domain_type=0))
+        self.bus = bus
+        self.name = name
+        self.osdmap = OSDMap(crush, n_osds)
+        for st in self.osdmap.osds:
+            st.up = False  # OSDs join via MOSDBoot
+        self.hb_grace = hb_grace
+        self.out_interval = out_interval
+        self.last_ping: dict[int, float] = {}
+        self.down_since: dict[int, float] = {}
+        self.subscribers: set[str] = set()
+        self.history: dict[int, bytes] = {}  # epoch -> encoded incremental
+        self._watchdog: asyncio.Task | None = None
+        self._next_pool_id = 1
+
+    # ---------------------------------------------------------- lifecycle
+
+    async def start(self) -> None:
+        self.bus.register(self.name, self.handle)
+        self._watchdog = asyncio.get_running_loop().create_task(
+            self._watch_loop()
+        )
+
+    async def stop(self) -> None:
+        if self._watchdog:
+            self._watchdog.cancel()
+        self.bus.unregister(self.name)
+
+    # ------------------------------------------------------------ mutation
+
+    async def commit(self, inc: Incremental) -> None:
+        """Apply one incremental and publish it (the Paxos-commit seam)."""
+        self.history[inc.epoch] = menc.encode_incremental(inc)
+        self.osdmap.apply_incremental(inc)
+        msg = M.MOSDMapMsg(
+            full=b"",
+            incrementals=[self.history[inc.epoch]],
+            epoch=self.osdmap.epoch,
+        )
+        for sub in list(self.subscribers):
+            try:
+                await self.bus.send(self.name, sub, msg)
+            except Exception:
+                self.subscribers.discard(sub)
+
+    def _new_inc(self) -> Incremental:
+        return Incremental(epoch=self.osdmap.epoch + 1)
+
+    # ------------------------------------------------------------ dispatch
+
+    async def handle(self, src: str, msg) -> None:
+        if isinstance(msg, M.MOSDBoot):
+            await self._handle_boot(src, msg)
+        elif isinstance(msg, M.MPing):
+            self.last_ping[msg.osd] = time.monotonic()
+        elif isinstance(msg, M.MMonGetMap):
+            await self._send_map(src, msg.have)
+        elif isinstance(msg, M.MMonSubscribe):
+            self.subscribers.add(src)
+            await self._send_map(src, 0)
+        elif isinstance(msg, M.MFailure):
+            await self._handle_failure(msg)
+        elif isinstance(msg, M.MPoolCreate):
+            await self._handle_pool_create(src, msg)
+
+    async def _handle_boot(self, src: str, msg: M.MOSDBoot) -> None:
+        osd = msg.osd
+        self.subscribers.add(src)
+        self.last_ping[osd] = time.monotonic()
+        st = self.osdmap.osds[osd]
+        inc = self._new_inc()
+        changed = False
+        if not st.up:
+            inc.up.append(osd)
+            changed = True
+        if st.weight == 0:
+            inc.weights[osd] = 0x10000  # boot brings a marked-out OSD in
+            changed = True
+        self.down_since.pop(osd, None)
+        if changed:
+            await self.commit(inc)
+        else:
+            await self._send_map(src, 0)
+
+    async def _handle_failure(self, msg: M.MFailure) -> None:
+        """Peer-reported failure (send_failures -> prepare_failure role).
+        A single report from a cluster member is trusted — the reference
+        corroborates across reporters (mon_osd_min_down_reporters) to
+        resist network partitions; with one mon the heartbeat watchdog
+        provides the second signal."""
+        osd = msg.target
+        if 0 <= osd < self.osdmap.n_osds and self.osdmap.osds[osd].up:
+            await self._mark_down(osd)
+
+    async def _handle_pool_create(self, src: str, msg: M.MPoolCreate) -> None:
+        pool, _ = menc._dec_pool(msg.pool, 0)
+        if pool.id < 0:
+            pool.id = self._next_pool_id
+        self._next_pool_id = max(self._next_pool_id, pool.id + 1)
+        inc = self._new_inc()
+        inc.new_pools.append(pool)
+        await self.commit(inc)
+        await self.bus.send(
+            self.name, src,
+            M.MPoolCreateReply(pool_id=pool.id, epoch=self.osdmap.epoch),
+        )
+
+    # ---------------------------------------------------------------- maps
+
+    async def _send_map(self, dst: str, have: int) -> None:
+        if have and all(e in self.history for e in
+                        range(have + 1, self.osdmap.epoch + 1)):
+            incs = [self.history[e]
+                    for e in range(have + 1, self.osdmap.epoch + 1)]
+            msg = M.MOSDMapMsg(full=b"", incrementals=incs,
+                               epoch=self.osdmap.epoch)
+        else:
+            msg = M.MOSDMapMsg(
+                full=menc.encode_osdmap(self.osdmap), incrementals=[],
+                epoch=self.osdmap.epoch,
+            )
+        await self.bus.send(self.name, dst, msg)
+
+    # -------------------------------------------------------------- health
+
+    async def _mark_down(self, osd: int) -> None:
+        inc = self._new_inc()
+        inc.down.append(osd)
+        self.down_since[osd] = time.monotonic()
+        self.last_ping.pop(osd, None)
+        await self.commit(inc)
+
+    async def _watch_loop(self) -> None:
+        period = min(self.hb_grace, self.out_interval) / 4
+        last_tick = time.monotonic()
+        while True:
+            await asyncio.sleep(period)
+            now = time.monotonic()
+            # Reactor stall compensation: if this loop itself could not
+            # run (single-core host busy, e.g. an XLA compile), peers
+            # could not ping either — credit everyone the stall so a
+            # blocked process does not read as a dead cluster.
+            stall = now - last_tick - period
+            last_tick = now
+            if stall > period:
+                for osd in self.last_ping:
+                    self.last_ping[osd] += stall
+            for osd, seen in list(self.last_ping.items()):
+                if self.osdmap.osds[osd].up and now - seen > self.hb_grace:
+                    await self._mark_down(osd)
+            # down -> out: zero the reweight so CRUSH re-places the data
+            # (capacity elasticity == "edit the map", SURVEY §5)
+            for osd, since in list(self.down_since.items()):
+                if now - since > self.out_interval and (
+                    self.osdmap.osds[osd].weight != 0
+                ):
+                    inc = self._new_inc()
+                    inc.weights[osd] = 0
+                    await self.commit(inc)

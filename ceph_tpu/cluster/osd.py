@@ -1,0 +1,369 @@
+"""OSDLite: the data daemon (src/osd/OSD.cc role, asyncio single-reactor).
+
+Boot -> mon admission -> map subscription -> PG instantiation from the
+map (and from on-disk collections after restart) -> dispatch of client
+ops / sub-ops / peering traffic to PGs. Heartbeats flow OSD->mon; send
+failures to peers are reported as MFailure (the send_failures ->
+prepare_failure arc, OSD.cc:7099, OSDMonitor.cc:3325).
+
+The ECBatcher here is the TPU-native heart of the write path: every EC
+stripe submitted during one reactor tick is encoded in ONE batched
+device dispatch (ceph_tpu.ec encode_batch over (B, k, W) uint32), which
+is how the framework amortizes host<->device latency that a per-stripe
+codec call (the reference's jerasure path) cannot.
+"""
+from __future__ import annotations
+
+import asyncio
+import sys
+import traceback
+
+import numpy as np
+
+from ..ec import load_codec
+from ..placement import encoding as menc
+from ..store.memstore import MemStore
+from . import messages as M
+from .pg import NONE, PG
+
+_FAILED = object()
+
+
+class ECBatcher:
+    """Collects EC stripes for one reactor tick, encodes them as one
+    device batch per (codec profile, chunk words) bucket."""
+
+    def __init__(self) -> None:
+        self._pending: dict[tuple, list] = {}
+        self._flushing = False
+
+    async def encode(self, codec, data: bytes) -> dict[int, np.ndarray]:
+        """-> {chunk_index: uint8 chunk} for one stripe; batches with
+        every other stripe submitted in the same tick."""
+        from ..ops import rs
+
+        blocksize = codec.get_chunk_size(len(data))
+        padded = np.zeros(blocksize * codec.k, dtype=np.uint8)
+        padded[: len(data)] = np.frombuffer(data, dtype=np.uint8)
+        stripe = rs.pack_u32(padded.reshape(codec.k, blocksize))
+        key = (id(codec), blocksize)
+        fut = asyncio.get_running_loop().create_future()
+        self._pending.setdefault(key, []).append((codec, stripe, fut))
+        if not self._flushing:
+            self._flushing = True
+            asyncio.get_running_loop().call_soon(self._flush)
+        chunks_u32 = await fut
+        if chunks_u32 is _FAILED:
+            raise RuntimeError("batched encode failed")
+        out = {}
+        for j in range(codec.get_chunk_count()):
+            out[codec.chunk_index(j)] = rs.unpack_u32(chunks_u32[j])
+        return out
+
+    def _flush(self) -> None:
+        from ..ops import rs
+
+        self._flushing = False
+        pending, self._pending = self._pending, {}
+        for (_cid, _bs), items in pending.items():
+            codec = items[0][0]
+            batch = np.stack([stripe for _, stripe, _ in items])
+            try:
+                parity = np.asarray(codec.encode_batch(batch))
+            except Exception:
+                for _, _, fut in items:
+                    if not fut.done():
+                        fut.set_result(_FAILED)
+                continue
+            for i, (_, stripe, fut) in enumerate(items):
+                full = np.concatenate([stripe, parity[i]], axis=0)
+                if not fut.done():
+                    fut.set_result(full)
+
+
+class OSDLite:
+    def __init__(
+        self,
+        bus,
+        osd_id: int,
+        store=None,
+        hb_interval: float = 0.25,
+        subop_timeout: float = 3.0,
+        log_keep: int = 128,
+    ):
+        self.bus = bus
+        self.id = osd_id
+        self.name = f"osd.{osd_id}"
+        self.store = store if store is not None else MemStore()
+        self.osdmap = None
+        self.pgs: dict[tuple[int, int, int], PG] = {}  # (pool, ps, shard)
+        self.hb_interval = hb_interval
+        self.subop_timeout = subop_timeout
+        self.log_keep = log_keep
+        self.ec_batcher = ECBatcher()
+        self.pending: dict = {}  # key -> Future (sub-op replies)
+        self._subtid = 0
+        self._codecs: dict[int, object] = {}
+        self._hb_task: asyncio.Task | None = None
+        self._tasks: set[asyncio.Task] = set()
+        self.stopped = False
+
+    # ----------------------------------------------------------- plumbing
+
+    def spawn(self, coro) -> asyncio.Task:
+        task = asyncio.get_running_loop().create_task(coro)
+        self._tasks.add(task)
+        task.add_done_callback(self._tasks.discard)
+        return task
+
+    def log_exc(self, what: str) -> None:
+        print(f"[{self.name}] {what}:", file=sys.stderr)
+        traceback.print_exc()
+
+    async def send(self, dst: str, msg) -> None:
+        try:
+            await self.bus.send(self.name, dst, msg)
+        except Exception:
+            if dst.startswith("osd."):
+                # fast failure path: tell the mon this peer is unreachable
+                try:
+                    await self.bus.send(
+                        self.name, "mon",
+                        M.MFailure(target=int(dst[4:]), reporter=self.name),
+                    )
+                except Exception:
+                    pass
+            raise
+
+    def new_subtid(self) -> int:
+        self._subtid += 1
+        return self._subtid
+
+    def expect_reply(self, key) -> asyncio.Future:
+        fut = asyncio.get_running_loop().create_future()
+        self.pending[key] = fut
+        return fut
+
+    def drop_reply(self, key) -> None:
+        self.pending.pop(key, None)
+
+    def _resolve(self, key, value) -> None:
+        fut = self.pending.pop(key, None)
+        if fut is not None and not fut.done():
+            fut.set_result(value)
+
+    async def await_reply(self, key, fut, target_osd: int):
+        try:
+            return await asyncio.wait_for(fut, self.subop_timeout)
+        except asyncio.TimeoutError:
+            self.drop_reply(key)
+            try:
+                await self.bus.send(
+                    self.name, "mon",
+                    M.MFailure(target=target_osd, reporter=self.name),
+                )
+            except Exception:
+                pass
+            raise
+
+    async def gather(self, waits) -> None:
+        """Await sub-op acks: waits = [(osd, subtid, fut)]."""
+        for osd, subtid, fut in waits:
+            reply = await self.await_reply(subtid, fut, osd)
+            if reply.result != M.OK:
+                raise RuntimeError(
+                    f"sub-op {subtid} on osd.{osd}: {reply.result}"
+                )
+
+    def codec_for(self, pool):
+        codec = self._codecs.get(pool.id)
+        if codec is None:
+            codec = load_codec(dict(pool.ec_profile))
+            self._codecs[pool.id] = codec
+        return codec
+
+    # ---------------------------------------------------------- lifecycle
+
+    async def start(self) -> None:
+        self.stopped = False
+        self.bus.register(self.name, self.handle)
+        await self.bus.send(self.name, "mon", M.MOSDBoot(osd=self.id))
+        self._hb_task = asyncio.get_running_loop().create_task(
+            self._hb_loop()
+        )
+
+    async def stop(self) -> None:
+        """Crash-stop: no goodbyes (kill_osd role, ceph_manager.py:336)."""
+        self.stopped = True
+        if self._hb_task:
+            self._hb_task.cancel()
+        for t in list(self._tasks):
+            t.cancel()
+        self.bus.unregister(self.name)
+        for pg in self.pgs.values():
+            if pg._peer_task and not pg._peer_task.done():
+                pg._peer_task.cancel()
+
+    async def _hb_loop(self) -> None:
+        while True:
+            try:
+                await self.bus.send(
+                    self.name, "mon",
+                    M.MPing(osd=self.id,
+                            epoch=self.osdmap.epoch if self.osdmap else 0),
+                )
+            except Exception:
+                pass
+            await asyncio.sleep(self.hb_interval)
+
+    # ------------------------------------------------------------ dispatch
+
+    async def handle(self, src: str, msg) -> None:
+        if self.stopped:
+            return
+        try:
+            await self._handle(src, msg)
+        except Exception:
+            self.log_exc(f"dispatch {type(msg).__name__} from {src}")
+
+    async def _handle(self, src: str, msg) -> None:
+        if isinstance(msg, M.MOSDMapMsg):
+            await self._handle_map(msg)
+        elif isinstance(msg, M.MOSDOp):
+            pg = self._pg_for_primary(msg.pgid)
+            if pg is None:
+                await self.send(
+                    src,
+                    M.MOSDOpReply(tid=msg.tid, result=M.ESTALE, data=b"",
+                                  size=0,
+                                  epoch=self.osdmap.epoch if self.osdmap
+                                  else 0),
+                )
+                return
+            await pg.do_op(src, msg)
+        elif isinstance(msg, M.MOSDRepOp):
+            pg = self._ensure_pg(msg.pgid, -1)
+            await pg.handle_rep_op(src, msg)
+        elif isinstance(msg, M.MOSDRepOpReply):
+            self._resolve(msg.tid, msg)
+        elif isinstance(msg, M.MECSubWrite):
+            pg = self._ensure_pg(msg.pgid, msg.shard)
+            await pg.handle_ec_write(src, msg)
+        elif isinstance(msg, M.MECSubWriteReply):
+            self._resolve(msg.tid, msg)
+        elif isinstance(msg, M.MECSubRead):
+            pg = self._ensure_pg(msg.pgid, msg.shard)
+            await pg.handle_ec_read(src, msg)
+        elif isinstance(msg, M.MECSubReadReply):
+            self._resolve(msg.tid, msg)
+        elif isinstance(msg, M.MPGInfoReq):
+            pg = self._ensure_pg(msg.pgid, msg.shard)
+            await pg.handle_info_req(src, msg)
+        elif isinstance(msg, M.MPGInfoReply):
+            osd_id = int(src[4:])
+            self._resolve(("info", msg.pgid, osd_id, msg.shard), msg)
+        elif isinstance(msg, M.MPGScan):
+            pg = self._ensure_pg(msg.pgid, msg.shard)
+            await pg.handle_scan(src, msg)
+        elif isinstance(msg, M.MPGScanReply):
+            osd_id = int(src[4:])
+            self._resolve(("scan", msg.pgid, osd_id, msg.shard), msg)
+        elif isinstance(msg, M.MPull):
+            pg = self._ensure_pg(msg.pgid, msg.shard)
+            await pg.handle_pull(src, msg)
+        elif isinstance(msg, M.MPushOp):
+            # two roles: a primary pushing recovery to us, or the answer
+            # to our own MPull (self-recovery) — resolve a pending pull
+            # future if one matches, else install as a peer push
+            key = ("push", msg.pgid, self._my_shard(msg.pgid, msg.shard),
+                   msg.oid)
+            pg = self._ensure_pg(msg.pgid, self._my_shard(msg.pgid,
+                                                          msg.shard))
+            if key in self.pending:
+                await pg.handle_push(src, msg)
+                self._resolve(key, msg)
+            else:
+                await pg.handle_push(src, msg)
+        elif isinstance(msg, M.MPushReply):
+            osd_id = int(src[4:])
+            self._resolve(("pushr", msg.pgid, msg.shard, msg.oid, osd_id),
+                          msg)
+
+    def _my_shard(self, pgid, msg_shard: int) -> int:
+        """The shard *this* OSD holds for pgid (push messages carry the
+        destination shard for peer pushes; for pull answers the shard is
+        the source's — our own instance key wins)."""
+        for (pool, ps, shard) in self.pgs:
+            if (pool, ps) == pgid:
+                return shard
+        return msg_shard
+
+    def _pg_for_primary(self, pgid) -> PG | None:
+        """The instance that should serve client ops for pgid under the
+        CURRENT map — never a stray from an older epoch."""
+        if self.osdmap is None or pgid[0] not in self.osdmap.pools:
+            return None
+        pool = self.osdmap.pools[pgid[0]]
+        up, primary = self.osdmap.pg_to_up_acting_osds(pgid)
+        if primary != self.id or self.id not in up:
+            return None
+        shard = up.index(self.id) if pool.type == "erasure" else -1
+        pg = self._ensure_pg(pgid, shard)
+        if not pg.acting:
+            pg.on_map(up, primary)
+        return pg
+
+    def _ensure_pg(self, pgid, shard: int) -> PG:
+        key = (pgid[0], pgid[1], shard)
+        pg = self.pgs.get(key)
+        if pg is None:
+            pg = PG(self, pgid, shard)
+            if self.osdmap is not None and pgid[0] in self.osdmap.pools:
+                pg.acting, pg.primary = \
+                    self.osdmap.pg_to_up_acting_osds(pgid)
+            self.pgs[key] = pg
+        return pg
+
+    # ----------------------------------------------------------- map flow
+
+    async def _handle_map(self, msg: M.MOSDMapMsg) -> None:
+        if msg.full:
+            m, _ = menc.decode_osdmap(msg.full)
+            self.osdmap = m
+        for raw in msg.incrementals:
+            inc, _ = menc.decode_incremental(raw)
+            if self.osdmap is None or inc.epoch != self.osdmap.epoch + 1:
+                if self.osdmap is not None and inc.epoch <= self.osdmap.epoch:
+                    continue
+                await self.bus.send(
+                    self.name, "mon",
+                    M.MMonGetMap(have=self.osdmap.epoch if self.osdmap
+                                 else 0),
+                )
+                return
+            self.osdmap.apply_incremental(inc)
+        if not self.osdmap.osds[self.id].up:
+            # wrongly marked down while alive: re-assert ourselves (the
+            # reference OSD restarts its boot sequence on seeing itself
+            # down in a new map)
+            await self.bus.send(self.name, "mon", M.MOSDBoot(osd=self.id))
+        self._scan_pgs()
+
+    def _scan_pgs(self) -> None:
+        """Instantiate/refresh PGs this OSD hosts under the current map
+        (consume_map -> load PGs role, OSD.cc:3732)."""
+        if self.osdmap is None:
+            return
+        for pool in self.osdmap.pools.values():
+            ec = pool.type == "erasure"
+            for ps in range(pool.pg_num):
+                pgid = (pool.id, ps)
+                up, primary = self.osdmap.pg_to_up_acting_osds(pgid)
+                if self.id in up:
+                    self._ensure_pg(pgid, up.index(self.id) if ec else -1)
+                # every instance of this pgid (member or stray — strays
+                # stay on disk like the reference's lazy removal) learns
+                # the new acting set
+                for key, pg in list(self.pgs.items()):
+                    if (key[0], key[1]) == pgid:
+                        pg.on_map(up, primary)

@@ -1,0 +1,747 @@
+"""PG: per-placement-group op execution, backends, peering, recovery.
+
+The PrimaryLogPG + PGBackend role (src/osd/PrimaryLogPG.cc:1987 do_op,
+ReplicatedBackend.cc:465, ECBackend.cc:1539), futurized on one asyncio
+reactor (the Crimson stance) instead of sharded op queues + locks.
+
+Roles: every member OSD of a PG holds a PG instance. `shard` is -1 for
+replicated members, the positional chunk index for EC members (CRUSH
+indep keeps positions stable). The primary (first live member) executes
+client ops, stamps log versions, fans sub-ops out, and drives peering +
+recovery; replicas/shards apply sub-ops and answer info/pull requests.
+
+TPU-first data path: EC encode goes through the owning OSD's ECBatcher —
+stripes submitted in the same reactor tick are encoded as ONE batched
+device dispatch (ceph_tpu.ec encode_batch), the host<->device
+amortization the reference cannot express (its jerasure calls are
+per-stripe, ErasureCodeJerasure.cc:105). Degraded reads reconstruct via
+minimum_to_decode + decode (ECBackend.cc:2405 objects_read_and_
+reconstruct role); per-chunk CRC32C hinfo attrs mirror ECUtil's
+hash_info and are verified on every sub-read.
+
+Writes complete only after every live member commits (primary-copy,
+all-ack), which is what keeps the PGLog calculus prefix-shaped — see
+pglog.py for the consequences for peering.
+"""
+from __future__ import annotations
+
+import asyncio
+from typing import TYPE_CHECKING
+
+import numpy as np
+
+from .. import native
+from ..store import transaction as tx
+from ..utils import denc
+from . import messages as M
+from .pglog import OP_DELETE, OP_MODIFY, ZERO, Entry, PGInfo, PGLog
+
+if TYPE_CHECKING:
+    from .osd import OSDLite
+
+NONE = 0x7FFFFFFF  # placement ITEM_NONE
+META_OID = b"_pgmeta"
+
+ATTR_V = "v"
+ATTR_SIZE = "size"
+ATTR_HINFO = "hinfo"
+
+
+def enc_ver(v: tuple[int, int]) -> bytes:
+    return denc.enc_u32(v[0]) + denc.enc_u64(v[1])
+
+
+def dec_ver(b: bytes) -> tuple[int, int]:
+    e, off = denc.dec_u32(b, 0)
+    s, _ = denc.dec_u64(b, off)
+    return (e, s)
+
+
+class PG:
+    def __init__(self, osd: "OSDLite", pgid: tuple[int, int], shard: int):
+        self.osd = osd
+        self.pgid = pgid
+        self.shard = shard  # -1 replicated, else EC chunk position
+        self.cid = (
+            f"{pgid[0]}.{pgid[1]}"
+            if shard < 0
+            else f"{pgid[0]}.{pgid[1]}s{shard}"
+        )
+        self.log = PGLog()
+        self.acting: list[int] = []
+        self.primary: int = -1
+        self.state = "peering"
+        self.waiting: list[tuple[str, M.MOSDOp]] = []
+        self.lock = asyncio.Lock()
+        self._peer_task: asyncio.Task | None = None
+        self._load()
+
+    # ----------------------------------------------------------- identity
+
+    @property
+    def pool(self):
+        return self.osd.osdmap.pools[self.pgid[0]]
+
+    @property
+    def is_ec(self) -> bool:
+        return self.shard >= 0
+
+    def is_primary(self) -> bool:
+        return self.primary == self.osd.id
+
+    def live_members(self) -> list[tuple[int, int]]:
+        """[(osd, shard)] of up members per the CURRENT map, holes
+        skipped. Computed from the osdmap (not the cached acting set) so
+        the data path never acts on a stale membership snapshot."""
+        up, _ = self.osd.osdmap.pg_to_up_acting_osds(self.pgid)
+        out = []
+        for pos, o in enumerate(up):
+            if o != NONE:
+                out.append((o, pos if self.is_ec else -1))
+        return out
+
+    # -------------------------------------------------------- persistence
+
+    def _load(self) -> None:
+        store = self.osd.store
+        if self.cid in store.list_collections():
+            try:
+                raw = store.read(self.cid, META_OID)
+            except Exception:
+                return
+            if raw:
+                self.log, _ = PGLog.decode(raw)
+
+    def _ensure_coll(self, t: tx.Transaction) -> None:
+        if self.cid not in self.osd.store.list_collections():
+            t.create_collection(self.cid)
+
+    def _persist_log(self, t: tx.Transaction) -> None:
+        enc = self.log.encode()
+        t.truncate(self.cid, META_OID, 0)
+        t.write(self.cid, META_OID, 0, enc)
+
+    def _append_and_persist(self, entry: Entry, t: tx.Transaction) -> None:
+        self.log.append(entry)
+        self.log.trim(self.osd.log_keep)
+        self._persist_log(t)
+
+    def next_version(self) -> tuple[int, int]:
+        return (self.osd.osdmap.epoch, self.log.head[1] + 1)
+
+    # ------------------------------------------------------- map handling
+
+    def on_map(self, acting: list[int], primary: int) -> None:
+        """Called on every map change affecting this PG."""
+        membership_changed = (acting != self.acting or
+                              primary != self.primary)
+        self.acting = list(acting)
+        self.primary = primary
+        if not membership_changed and self.state == "active":
+            return
+        if self.is_primary():
+            if membership_changed or self.state != "active":
+                self.state = "peering"
+                if self._peer_task is None or self._peer_task.done():
+                    self._peer_task = asyncio.get_running_loop().create_task(
+                        self._peer_and_recover()
+                    )
+        else:
+            # replicas serve sub-ops in any state; mark active
+            self.state = "active"
+            self._flush_waiting_stale()
+
+    def _flush_waiting_stale(self) -> None:
+        """Lost primaryship: bounce queued clients so they re-target."""
+        waiting, self.waiting = self.waiting, []
+        for src, m in waiting:
+            self.osd.spawn(
+                self.osd.send(
+                    src,
+                    M.MOSDOpReply(
+                        tid=m.tid, result=M.ESTALE, data=b"", size=0,
+                        epoch=self.osd.osdmap.epoch,
+                    ),
+                )
+            )
+
+    # ====================================================== client ops ==
+
+    async def do_op(self, src: str, m: M.MOSDOp) -> None:
+        if not self.is_primary():
+            await self.osd.send(
+                src,
+                M.MOSDOpReply(tid=m.tid, result=M.ESTALE, data=b"", size=0,
+                              epoch=self.osd.osdmap.epoch),
+            )
+            return
+        if self.state != "active":
+            self.waiting.append((src, m))
+            return
+        try:
+            if m.op == "writefull":
+                async with self.lock:
+                    await self._op_writefull(m.oid, m.data)
+                reply = M.MOSDOpReply(tid=m.tid, result=M.OK, data=b"",
+                                      size=len(m.data),
+                                      epoch=self.osd.osdmap.epoch)
+            elif m.op == "delete":
+                async with self.lock:
+                    await self._op_delete(m.oid)
+                reply = M.MOSDOpReply(tid=m.tid, result=M.OK, data=b"",
+                                      size=0, epoch=self.osd.osdmap.epoch)
+            elif m.op in ("read", "stat"):
+                data, size = await self._op_read(m.oid)
+                if m.op == "stat":
+                    data = b""
+                elif m.length >= 0:
+                    data = data[m.offset : m.offset + m.length]
+                elif m.offset:
+                    data = data[m.offset :]
+                reply = M.MOSDOpReply(tid=m.tid, result=M.OK, data=data,
+                                      size=size,
+                                      epoch=self.osd.osdmap.epoch)
+            else:
+                reply = M.MOSDOpReply(tid=m.tid, result=M.EAGAIN, data=b"",
+                                      size=0, epoch=self.osd.osdmap.epoch)
+        except KeyError:
+            reply = M.MOSDOpReply(tid=m.tid, result=M.ENOENT, data=b"",
+                                  size=0, epoch=self.osd.osdmap.epoch)
+        except Exception:
+            self.osd.log_exc(f"pg {self.pgid} op {m.op}")
+            reply = M.MOSDOpReply(tid=m.tid, result=M.EAGAIN, data=b"",
+                                  size=0, epoch=self.osd.osdmap.epoch)
+        await self.osd.send(src, reply)
+
+    # ------------------------------------------------------------- writes
+
+    async def _op_writefull(self, oid: bytes, data: bytes) -> None:
+        version = self.next_version()
+        prior = self._object_version(oid)
+        entry = Entry(OP_MODIFY, oid, version, prior)
+        if self.is_ec:
+            await self._write_ec(oid, data, entry)
+        else:
+            await self._write_replicated(oid, data, entry)
+
+    async def _op_delete(self, oid: bytes) -> None:
+        version = self.next_version()
+        prior = self._object_version(oid)
+        entry = Entry(OP_DELETE, oid, version, prior)
+        if self.is_ec:
+            await self._write_ec(oid, None, entry)
+        else:
+            await self._write_replicated(oid, None, entry)
+
+    def _object_version(self, oid: bytes) -> tuple[int, int]:
+        try:
+            return dec_ver(self.osd.store.getattr(self.cid, oid, ATTR_V))
+        except Exception:
+            return ZERO
+
+    def _local_txn(self, oid: bytes, payload: bytes | None,
+                   version, attrs: dict[str, bytes],
+                   entry: Entry) -> tx.Transaction:
+        t = tx.Transaction()
+        self._ensure_coll(t)
+        if payload is None:
+            if self.osd.store.exists(self.cid, oid):
+                t.remove(self.cid, oid)
+        else:
+            t.truncate(self.cid, oid, 0)
+            t.write(self.cid, oid, 0, payload)
+            t.setattrs(self.cid, oid, {ATTR_V: enc_ver(version), **attrs})
+        self._append_and_persist(entry, t)
+        return t
+
+    @staticmethod
+    def _remote_txn(cid: str, oid: bytes, payload: bytes | None,
+                    version, attrs: dict[str, bytes]) -> tx.Transaction:
+        """Transaction shipped to a peer (its PG appends the log entry and
+        persists it into the same transaction on arrival)."""
+        t = tx.Transaction()
+        if payload is None:
+            t.remove(cid, oid)  # receiver filters if it never had it
+        else:
+            t.truncate(cid, oid, 0)
+            t.write(cid, oid, 0, payload)
+            t.setattrs(cid, oid, {ATTR_V: enc_ver(version), **attrs})
+        return t
+
+    async def _write_replicated(self, oid: bytes, data: bytes | None,
+                                entry: Entry) -> None:
+        version = entry.version
+        peers = [(o, s) for o, s in self.live_members()
+                 if o != self.osd.id]
+        # local apply first (primary orders), then fan out, ack on all
+        self.osd.store.queue_transaction(
+            self._local_txn(oid, data, version, {}, entry)
+        )
+        await self._fanout_rep(peers, oid, data, version, entry)
+
+    async def _fanout_rep(self, peers, oid, data, version, entry) -> None:
+        waits = []
+        for o, _s in peers:
+            rt = self._remote_txn(f"{self.pgid[0]}.{self.pgid[1]}", oid,
+                                  data, version, {})
+            subtid = self.osd.new_subtid()
+            fut = self.osd.expect_reply(subtid)
+            waits.append((o, subtid, fut))
+            await self.osd.send(
+                f"osd.{o}",
+                M.MOSDRepOp(tid=subtid, pgid=self.pgid, txn=rt.encode(),
+                            entry=entry.encode(),
+                            epoch=self.osd.osdmap.epoch),
+            )
+        await self.osd.gather(waits)
+
+    async def _write_ec(self, oid: bytes, data: bytes | None,
+                        entry: Entry) -> None:
+        version = entry.version
+        codec = self.osd.codec_for(self.pool)
+        k, n = codec.k, codec.get_chunk_count()
+        live = {s: o for o, s in self.live_members()}
+        if len(live) < k:
+            raise RuntimeError(f"pg {self.pgid}: {len(live)} < k={k} shards")
+        if data is None:
+            chunks = {j: None for j in range(n)}
+            size = 0
+        else:
+            encoded = await self.osd.ec_batcher.encode(codec, data)
+            chunks = {j: encoded[j].tobytes() for j in range(n)}
+            size = len(data)
+        waits = []
+        for j in range(n):
+            if j not in live:
+                continue  # degraded write: the hole recovers via peering
+            payload = chunks[j]
+            attrs = {}
+            if payload is not None:
+                attrs = {
+                    ATTR_SIZE: denc.enc_u64(size),
+                    ATTR_HINFO: denc.enc_u32(
+                        native.crc32c(np.frombuffer(payload, np.uint8))
+                    ),
+                }
+            target = live[j]
+            if target == self.osd.id:
+                self.osd.store.queue_transaction(
+                    self._local_txn(oid, payload, version, attrs, entry)
+                )
+                continue
+            cid = f"{self.pgid[0]}.{self.pgid[1]}s{j}"
+            rt = self._remote_txn(cid, oid, payload, version, attrs)
+            subtid = self.osd.new_subtid()
+            fut = self.osd.expect_reply(subtid)
+            waits.append((target, subtid, fut))
+            await self.osd.send(
+                f"osd.{target}",
+                M.MECSubWrite(tid=subtid, pgid=self.pgid, shard=j,
+                              txn=rt.encode(), entry=entry.encode(),
+                              epoch=self.osd.osdmap.epoch),
+            )
+        await self.osd.gather(waits)
+
+    # -------------------------------------------------------------- reads
+
+    async def _op_read(self, oid: bytes) -> tuple[bytes, int]:
+        if not self.is_ec:
+            data = self.osd.store.read(self.cid, oid)
+            return bytes(data), len(data)
+        return await self._read_ec(oid)
+
+    async def _read_ec(self, oid: bytes) -> tuple[bytes, int]:
+        """Gather k chunks (degraded: any k, then decode) and concat.
+
+        The objects_read_and_reconstruct role (ECBackend.cc:2405):
+        minimum_to_decode picks the fetch set from available shards,
+        sub-reads verify hinfo CRCs, decode rebuilds missing data chunks.
+        """
+        codec = self.osd.codec_for(self.pool)
+        k = codec.k
+        live = {s: o for o, s in self.live_members()}
+        want = list(range(k))
+        available = sorted(live)
+        need = codec.minimum_to_decode(want, available)
+        chunks: dict[int, bytes] = {}
+        size = None
+        waits = []
+        for j in sorted(need):
+            target = live[j]
+            if target == self.osd.id:
+                cid = f"{self.pgid[0]}.{self.pgid[1]}s{j}"
+                chunk = bytes(self.osd.store.read(cid, oid))
+                self._verify_hinfo(cid, oid, chunk)
+                chunks[j] = chunk
+                size = denc.dec_u64(
+                    self.osd.store.getattr(cid, oid, ATTR_SIZE), 0
+                )[0]
+                continue
+            subtid = self.osd.new_subtid()
+            fut = self.osd.expect_reply(subtid)
+            waits.append((j, target, subtid, fut))
+            await self.osd.send(
+                f"osd.{target}",
+                M.MECSubRead(tid=subtid, pgid=self.pgid, shard=j, oid=oid,
+                             offset=0, length=-1),
+            )
+        for j, target, subtid, fut in waits:
+            reply = await self.osd.await_reply(subtid, fut, target)
+            if reply.result != M.OK:
+                raise KeyError(oid)  # shard lost it -> ENOENT upward
+            chunks[j] = reply.data
+            if size is None:
+                size = reply.size
+        if size is None:
+            raise KeyError(oid)
+        decoded = codec.decode(want, chunks)
+        data = b"".join(decoded[j].tobytes() for j in want)
+        return data[:size], size
+
+    def _verify_hinfo(self, cid: str, oid: bytes, chunk: bytes) -> None:
+        stored = denc.dec_u32(
+            self.osd.store.getattr(cid, oid, ATTR_HINFO), 0
+        )[0]
+        actual = native.crc32c(np.frombuffer(chunk, np.uint8))
+        if stored != actual:
+            raise IOError(
+                f"hinfo mismatch on {cid}/{oid!r}: {stored:#x} != "
+                f"{actual:#x}"
+            )
+
+    # ================================================== sub-op handlers ==
+
+    async def handle_rep_op(self, src: str, m: M.MOSDRepOp) -> None:
+        t, _ = tx.Transaction.decode(m.txn)
+        entry, _ = Entry.decode(m.entry)
+        full = tx.Transaction()
+        if self.cid not in self.osd.store.list_collections():
+            full.create_collection(self.cid)
+        full.ops.extend(self._filter_remote_ops(t))
+        if entry.version > self.log.head:
+            self.log.append(entry)
+            self.log.trim(self.osd.log_keep)
+        self._persist_log(full)
+        self.osd.store.queue_transaction(full)
+        await self.osd.send(
+            src,
+            M.MOSDRepOpReply(tid=m.tid, pgid=self.pgid, result=M.OK,
+                             osd=self.osd.id),
+        )
+
+    async def handle_ec_write(self, src: str, m: M.MECSubWrite) -> None:
+        t, _ = tx.Transaction.decode(m.txn)
+        entry, _ = Entry.decode(m.entry)
+        full = tx.Transaction()
+        if self.cid not in self.osd.store.list_collections():
+            full.create_collection(self.cid)
+        full.ops.extend(self._filter_remote_ops(t))
+        if entry.version > self.log.head:
+            self.log.append(entry)
+            self.log.trim(self.osd.log_keep)
+        self._persist_log(full)
+        self.osd.store.queue_transaction(full)
+        await self.osd.send(
+            src,
+            M.MECSubWriteReply(tid=m.tid, pgid=self.pgid, shard=m.shard,
+                               result=M.OK),
+        )
+
+    def _filter_remote_ops(self, t: tx.Transaction) -> list:
+        """Drop remove ops for objects we do not hold (delete of a never-
+        recovered object on a revived shard must not fail the txn)."""
+        ops = []
+        for op in t.ops:
+            if op.code == tx.OP_REMOVE and not self.osd.store.exists(
+                op.cid, op.oid
+            ):
+                continue
+            ops.append(op)
+        return ops
+
+    async def handle_ec_read(self, src: str, m: M.MECSubRead) -> None:
+        try:
+            chunk = bytes(self.osd.store.read(self.cid, m.oid))
+            self._verify_hinfo(self.cid, m.oid, chunk)
+            digest = denc.dec_u32(
+                self.osd.store.getattr(self.cid, m.oid, ATTR_HINFO), 0
+            )[0]
+            size = denc.dec_u64(
+                self.osd.store.getattr(self.cid, m.oid, ATTR_SIZE), 0
+            )[0]
+            reply = M.MECSubReadReply(tid=m.tid, pgid=self.pgid,
+                                      shard=m.shard, result=M.OK,
+                                      data=chunk, digest=digest, size=size)
+        except Exception:
+            reply = M.MECSubReadReply(tid=m.tid, pgid=self.pgid,
+                                      shard=m.shard, result=M.ENOENT,
+                                      data=b"", digest=0, size=0)
+        await self.osd.send(src, reply)
+
+    # ======================================================== peering ==
+
+    async def _peer_and_recover(self) -> None:
+        """Run peering rounds until one completes under a stable epoch
+        (a mid-round map change invalidates the round — the reference
+        restarts its PeeringMachine on AdvMap the same way)."""
+        try:
+            while self.is_primary() and self.state != "active":
+                if await self._do_peering():
+                    break
+                await asyncio.sleep(0.02)
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            self.osd.log_exc(f"pg {self.pgid} peering")
+
+    async def _do_peering(self) -> bool:
+        """GetInfo -> choose authoritative -> recover self -> recover
+        peers -> active (the PeeringState GetInfo/GetLog/GetMissing/
+        Activate arc, PeeringState.h:268, compressed for all-ack logs)."""
+        osd = self.osd
+        epoch = osd.osdmap.epoch
+        peers = [(o, s) for o, s in self.live_members() if o != osd.id]
+        infos: dict[tuple[int, int], PGInfo] = {
+            (osd.id, self.shard): PGInfo(self.log.head, self.log)
+        }
+        waits = []
+        for o, s in peers:
+            fut = osd.expect_reply(("info", self.pgid, o, s))
+            waits.append((o, s, fut))
+            await osd.send(
+                f"osd.{o}",
+                M.MPGInfoReq(pgid=self.pgid, epoch=epoch, shard=s),
+            )
+        for o, s, fut in waits:
+            try:
+                reply = await asyncio.wait_for(fut, osd.subop_timeout)
+            except asyncio.TimeoutError:
+                osd.drop_reply(("info", self.pgid, o, s))
+                continue  # peer died; map change will re-peer
+            info, _ = PGInfo.decode(reply.info)
+            infos[(o, s)] = info
+
+        if osd.osdmap.epoch != epoch:
+            return False  # superseded; caller retries under the new map
+
+        best_key = max(infos, key=lambda k: infos[k].last_update)
+        best = infos[best_key]
+
+        # -- recover self to authoritative
+        if best.last_update > self.log.head:
+            await self._recover_self(best_key, best)
+
+        # -- recover peers (delta or backfill)
+        for (o, s), info in infos.items():
+            if o == osd.id:
+                continue
+            missing = self.log.missing_after(info.last_update)
+            if missing is None:
+                await self._backfill_peer(o, s)
+            else:
+                for oid, e in missing.items():
+                    await self._push_object(o, s, oid, e)
+
+        if osd.osdmap.epoch != epoch:
+            return False
+        self.state = "active"
+        waiting, self.waiting = self.waiting, []
+        for src, m in waiting:
+            osd.spawn(self.do_op(src, m))
+        return True
+
+    async def _recover_self(self, best_key, best: PGInfo) -> None:
+        """Adopt the authoritative log, then repair our own copy: pull
+        whole objects from the authoritative peer (replicated) or
+        reconstruct our shard's chunks from k survivors (EC — a peer's
+        chunk is shard-specific and useless to us)."""
+        osd = self.osd
+        missing = best.log.missing_after(self.log.head)
+        self.log = best.log
+        t = tx.Transaction()
+        self._ensure_coll(t)
+        self._persist_log(t)
+        osd.store.queue_transaction(t)
+        o, s = best_key
+        if missing is None:
+            # too far behind: full backfill; any member's object list is
+            # the authoritative enumeration
+            fut = osd.expect_reply(("scan", self.pgid, o, s))
+            await osd.send(
+                f"osd.{o}",
+                M.MPGScan(pgid=self.pgid, shard=s, epoch=osd.osdmap.epoch),
+            )
+            reply = await asyncio.wait_for(fut, osd.subop_timeout)
+            todo = dict(reply.objects)
+        else:
+            todo = {
+                oid: e.version
+                for oid, e in missing.items()
+                if e.op != OP_DELETE
+            }
+            for oid, e in missing.items():
+                if e.op == OP_DELETE and osd.store.exists(self.cid, oid):
+                    t2 = tx.Transaction()
+                    t2.remove(self.cid, oid)
+                    osd.store.queue_transaction(t2)
+        for oid, version in todo.items():
+            if self._object_version(oid) == version:
+                continue
+            if self.is_ec:
+                await self._recover_own_chunk(oid, version)
+            else:
+                fut = osd.expect_reply(("push", self.pgid, self.shard, oid))
+                await osd.send(
+                    f"osd.{o}",
+                    M.MPull(pgid=self.pgid, shard=s, oid=oid,
+                            epoch=osd.osdmap.epoch),
+                )
+                await asyncio.wait_for(fut, osd.subop_timeout)
+
+    async def _recover_own_chunk(self, oid: bytes,
+                                 version: tuple[int, int]) -> None:
+        chunk, attrs = await self._reconstruct_chunk(oid, self.shard)
+        t = tx.Transaction()
+        self._ensure_coll(t)
+        t.truncate(self.cid, oid, 0)
+        t.write(self.cid, oid, 0, chunk)
+        t.setattrs(self.cid, oid, {**attrs, ATTR_V: enc_ver(version)})
+        self.osd.store.queue_transaction(t)
+
+    async def _backfill_peer(self, o: int, s: int) -> None:
+        """Push every object to a peer whose log diverged past our tail
+        (recover_backfill role — full rescan instead of log delta)."""
+        for oid in self.osd.store.list_objects(self.cid):
+            if oid == META_OID:
+                continue
+            v = self._object_version(oid)
+            await self._push_object(o, s, oid, Entry(OP_MODIFY, oid, v))
+
+    async def _push_object(self, o: int, s: int, oid: bytes,
+                           e: Entry) -> None:
+        """Push one object (or its EC chunk) to member (o, shard s)."""
+        osd = self.osd
+        if e.op == OP_DELETE:
+            data, attrs = None, {}
+        elif self.is_ec:
+            data, attrs = await self._reconstruct_chunk(oid, s)
+        else:
+            try:
+                data = bytes(osd.store.read(self.cid, oid))
+                attrs = osd.store.getattrs(self.cid, oid)
+            except Exception:
+                return  # deleted meanwhile
+        fut = osd.expect_reply(("pushr", self.pgid, s, oid, o))
+        await osd.send(
+            f"osd.{o}",
+            M.MPushOp(pgid=self.pgid, shard=s, oid=oid,
+                      version=e.version, data=data or b"",
+                      attrs=attrs if data is not None else
+                      {"_deleted": b"1"},
+                      epoch=osd.osdmap.epoch,
+                      last_update=self.log.head),
+        )
+        try:
+            await asyncio.wait_for(fut, osd.subop_timeout)
+        except asyncio.TimeoutError:
+            osd.drop_reply(("pushr", self.pgid, s, oid, o))
+
+    async def _reconstruct_chunk(self, oid: bytes, shard: int):
+        """Rebuild shard `shard`'s chunk from k survivors (the recovery
+        read-reconstruct path, ECBackend continue_recovery_op role)."""
+        codec = self.osd.codec_for(self.pool)
+        live = {s: o for o, s in self.live_members()}
+        available = [s for s in sorted(live) if s != shard]
+        need = codec.minimum_to_decode([shard], available)
+        chunks: dict[int, bytes] = {}
+        size_attr = None
+        remote_size = None
+        for j in sorted(need):
+            target = live[j]
+            cidj = f"{self.pgid[0]}.{self.pgid[1]}s{j}"
+            if target == self.osd.id:
+                chunks[j] = bytes(self.osd.store.read(cidj, oid))
+                size_attr = self.osd.store.getattr(cidj, oid, ATTR_SIZE)
+            else:
+                subtid = self.osd.new_subtid()
+                fut = self.osd.expect_reply(subtid)
+                await self.osd.send(
+                    f"osd.{target}",
+                    M.MECSubRead(tid=subtid, pgid=self.pgid, shard=j,
+                                 oid=oid, offset=0, length=-1),
+                )
+                reply = await self.osd.await_reply(subtid, fut, target)
+                if reply.result != M.OK:
+                    raise RuntimeError(f"recovery read failed shard {j}")
+                chunks[j] = reply.data
+                remote_size = reply.size
+        if size_attr is None:
+            size_attr = denc.enc_u64(remote_size or 0)
+        decoded = codec.decode([shard], chunks)
+        chunk = decoded[shard].tobytes()
+        return chunk, {
+            ATTR_SIZE: size_attr,
+            ATTR_HINFO: denc.enc_u32(
+                native.crc32c(np.frombuffer(chunk, np.uint8))
+            ),
+        }
+
+    # ---------------------------------------------- peering-side handlers
+
+    async def handle_info_req(self, src: str, m: M.MPGInfoReq) -> None:
+        info = PGInfo(self.log.head, self.log)
+        await self.osd.send(
+            src,
+            M.MPGInfoReply(pgid=self.pgid, epoch=self.osd.osdmap.epoch,
+                           shard=m.shard, info=info.encode()),
+        )
+
+    async def handle_scan(self, src: str, m: M.MPGScan) -> None:
+        objects = {}
+        if self.cid in self.osd.store.list_collections():
+            for oid in self.osd.store.list_objects(self.cid):
+                if oid != META_OID:
+                    objects[oid] = self._object_version(oid)
+        await self.osd.send(
+            src,
+            M.MPGScanReply(pgid=self.pgid, shard=m.shard, objects=objects),
+        )
+
+    async def handle_pull(self, src: str, m: M.MPull) -> None:
+        try:
+            data = bytes(self.osd.store.read(self.cid, m.oid))
+            attrs = self.osd.store.getattrs(self.cid, m.oid)
+            v = self._object_version(m.oid)
+        except Exception:
+            data, attrs, v = b"", {"_deleted": b"1"}, ZERO
+        await self.osd.send(
+            src,
+            M.MPushOp(pgid=self.pgid, shard=m.shard, oid=m.oid, version=v,
+                      data=data, attrs=attrs, epoch=self.osd.osdmap.epoch,
+                      last_update=self.log.head),
+        )
+
+    async def handle_push(self, src: str, m: M.MPushOp) -> None:
+        """Receive a recovery push: install object + attrs, ack."""
+        t = tx.Transaction()
+        self._ensure_coll(t)
+        if m.attrs.get("_deleted"):
+            if self.osd.store.exists(self.cid, m.oid):
+                t.remove(self.cid, m.oid)
+        else:
+            t.truncate(self.cid, m.oid, 0)
+            t.write(self.cid, m.oid, 0, m.data)
+            t.setattrs(self.cid, m.oid,
+                       {**m.attrs, ATTR_V: enc_ver(m.version)})
+        if m.last_update > self.log.head:
+            # pushes carry the pusher's log point; adopting it keeps a
+            # revived replica's next peering round delta-shaped
+            self.log.tail = m.last_update
+            self.log.entries = []
+        self._persist_log(t)
+        self.osd.store.queue_transaction(t)
+        await self.osd.send(
+            src,
+            M.MPushReply(pgid=self.pgid, shard=m.shard, oid=m.oid,
+                         result=M.OK),
+        )

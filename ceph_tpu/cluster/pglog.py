@@ -1,0 +1,136 @@
+"""Per-PG op log, info, and missing-set calculus (src/osd/PGLog.cc role).
+
+Versions are eversions: (epoch, seq) tuples ordered lexicographically —
+the primary stamps each op with its map epoch and a per-PG monotone seq,
+so log order is total. The log keeps `entries` newer than `tail`; an OSD
+whose last_update predates a peer's tail cannot delta-recover and needs
+backfill (the same tail test PGLog::proc_replica_log does).
+
+Simplification vs the reference, by design: writes complete only after
+every live member acks (no per-op rollback/divergent-branch merge), so
+authoritative-log selection reduces to "max last_update wins" and peer
+logs are always prefixes of the authoritative log when tails allow delta
+recovery. The reference's divergent-entry machinery (PGLog.cc
+_merge_divergent_entries) guards asynchronous ack modes we do not have.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ..utils import denc
+
+ZERO = (0, 0)
+
+OP_MODIFY = "modify"
+OP_DELETE = "delete"
+
+
+@dataclass
+class Entry:
+    op: str  # modify | delete
+    oid: bytes
+    version: tuple[int, int]
+    prior_version: tuple[int, int] = ZERO
+
+    def encode(self) -> bytes:
+        return b"".join(
+            (
+                denc.enc_str(self.op),
+                denc.enc_bytes(self.oid),
+                denc.enc_u32(self.version[0]),
+                denc.enc_u64(self.version[1]),
+                denc.enc_u32(self.prior_version[0]),
+                denc.enc_u64(self.prior_version[1]),
+            )
+        )
+
+    @classmethod
+    def decode(cls, buf: bytes, off: int = 0) -> tuple["Entry", int]:
+        op, off = denc.dec_str(buf, off)
+        oid, off = denc.dec_bytes(buf, off)
+        ve, off = denc.dec_u32(buf, off)
+        vs, off = denc.dec_u64(buf, off)
+        pe, off = denc.dec_u32(buf, off)
+        ps, off = denc.dec_u64(buf, off)
+        return cls(op, oid, (ve, vs), (pe, ps)), off
+
+
+@dataclass
+class PGLog:
+    tail: tuple[int, int] = ZERO  # everything <= tail is trimmed away
+    entries: list[Entry] = field(default_factory=list)
+
+    @property
+    def head(self) -> tuple[int, int]:
+        return self.entries[-1].version if self.entries else self.tail
+
+    def append(self, entry: Entry) -> None:
+        if entry.version <= self.head:
+            raise ValueError(
+                f"log entry {entry.version} not newer than head {self.head}"
+            )
+        self.entries.append(entry)
+
+    def trim(self, keep: int) -> None:
+        """Drop the oldest entries beyond `keep`, advancing tail."""
+        drop = len(self.entries) - keep
+        if drop > 0:
+            self.tail = self.entries[drop - 1].version
+            del self.entries[:drop]
+
+    def entries_after(self, v: tuple[int, int]) -> list[Entry] | None:
+        """Entries strictly newer than v, or None if v < tail (the peer
+        is too far behind for delta recovery -> backfill)."""
+        if v < self.tail:
+            return None
+        return [e for e in self.entries if e.version > v]
+
+    def missing_after(self, v: tuple[int, int]) -> dict[bytes, Entry] | None:
+        """Final per-object state a peer at last_update v lacks: oid ->
+        newest entry. None -> backfill required."""
+        delta = self.entries_after(v)
+        if delta is None:
+            return None
+        final: dict[bytes, Entry] = {}
+        for e in delta:
+            final[e.oid] = e
+        return final
+
+    def encode(self) -> bytes:
+        return b"".join(
+            (
+                denc.enc_u32(self.tail[0]),
+                denc.enc_u64(self.tail[1]),
+                denc.enc_list(self.entries, Entry.encode),
+            )
+        )
+
+    @classmethod
+    def decode(cls, buf: bytes, off: int = 0) -> tuple["PGLog", int]:
+        te, off = denc.dec_u32(buf, off)
+        ts, off = denc.dec_u64(buf, off)
+        entries, off = denc.dec_list(buf, off, Entry.decode)
+        return cls((te, ts), entries), off
+
+
+@dataclass
+class PGInfo:
+    """What peering exchanges (pg_info_t role): where a member's copy
+    stands, plus its log for authoritative selection."""
+
+    last_update: tuple[int, int] = ZERO
+    log: PGLog = field(default_factory=PGLog)
+
+    def encode(self) -> bytes:
+        return (
+            denc.enc_u32(self.last_update[0])
+            + denc.enc_u64(self.last_update[1])
+            + self.log.encode()
+        )
+
+    @classmethod
+    def decode(cls, buf: bytes, off: int = 0) -> tuple["PGInfo", int]:
+        e, off = denc.dec_u32(buf, off)
+        s, off = denc.dec_u64(buf, off)
+        log, off = PGLog.decode(buf, off)
+        return cls((e, s), log), off

@@ -1,0 +1,93 @@
+"""TestCluster: in-process dev cluster (the src/vstart.sh role).
+
+Assembles mon + N OSDs + a client on a LocalBus, with the thrashing
+hooks the qa tier uses (kill_osd / revive_osd / blackhole, the
+OSDThrasher verbs of qa/tasks/ceph_manager.py:202). OSD stores survive
+kill/revive — a revived OSD mounts the same store, exactly like a
+restarted daemon finding its data on disk.
+"""
+from __future__ import annotations
+
+import asyncio
+
+from ..msg.messenger import LocalBus
+from ..placement import crushmap as cm
+from ..store.memstore import MemStore
+from .client import RadosClient
+from .mon import MonLite
+from .osd import OSDLite
+
+
+class TestCluster:
+    def __init__(self, n_osds: int = 5, hb_grace: float = 2.0,
+                 out_interval: float = 4.0, hb_interval: float = 0.15,
+                 crush: cm.CrushMap | None = None):
+        self.bus = LocalBus()
+        self.n_osds = n_osds
+        self.mon = MonLite(self.bus, n_osds, crush=crush,
+                           hb_grace=hb_grace, out_interval=out_interval)
+        self.stores = [MemStore() for _ in range(n_osds)]
+        self.osds: list[OSDLite | None] = [None] * n_osds
+        self.hb_interval = hb_interval
+        self.client = RadosClient(self.bus)
+
+    async def start(self) -> None:
+        await self.mon.start()
+        for i in range(self.n_osds):
+            await self.start_osd(i)
+        await self.client.connect()
+
+    async def stop(self) -> None:
+        await self.client.close()
+        for i, osd in enumerate(self.osds):
+            if osd is not None:
+                await osd.stop()
+                self.osds[i] = None
+        await self.mon.stop()
+
+    async def start_osd(self, i: int) -> OSDLite:
+        osd = OSDLite(self.bus, i, store=self.stores[i],
+                      hb_interval=self.hb_interval)
+        self.osds[i] = osd
+        await osd.start()
+        return osd
+
+    async def kill_osd(self, i: int) -> None:
+        """Crash-stop: deregister from the bus without goodbye; the mon
+        notices via heartbeat timeout."""
+        osd = self.osds[i]
+        if osd is not None:
+            await osd.stop()
+            self.osds[i] = None
+
+    async def revive_osd(self, i: int) -> OSDLite:
+        return await self.start_osd(i)
+
+    async def wait_epoch(self, epoch: int, timeout: float = 10.0) -> None:
+        """Block until the mon map reaches `epoch`."""
+        async def _wait():
+            while self.mon.osdmap.epoch < epoch:
+                await asyncio.sleep(0.02)
+        await asyncio.wait_for(_wait(), timeout)
+
+    async def wait_down(self, osd_id: int, timeout: float = 10.0) -> None:
+        async def _wait():
+            while self.mon.osdmap.osds[osd_id].up:
+                await asyncio.sleep(0.02)
+        await asyncio.wait_for(_wait(), timeout)
+
+    async def wait_active(self, timeout: float = 10.0) -> None:
+        """Wait until every live OSD's PGs are active and map epochs have
+        converged (the `ceph health` wait-for-clean role)."""
+        async def _wait():
+            while True:
+                await asyncio.sleep(0.02)
+                epoch = self.mon.osdmap.epoch
+                live = [o for o in self.osds if o is not None]
+                if not all(o.osdmap is not None and
+                           o.osdmap.epoch == epoch for o in live):
+                    continue
+                if all(pg.state == "active"
+                       for o in live for pg in o.pgs.values()):
+                    return
+        await asyncio.wait_for(_wait(), timeout)

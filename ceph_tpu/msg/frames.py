@@ -1,0 +1,71 @@
+"""Length-prefixed, CRC32C-protected frames (the msgr2 frames_v2 role).
+
+Layout (little-endian, reference frames_v2.h:94-145 compressed to one
+segment — multi-segment scatter/gather is a bufferlist optimization the
+host control plane does not need):
+
+    magic   u32   0x43545046 ("FPTC" LE)
+    type    u16   message type id
+    flags   u16   reserved
+    length  u32   payload byte count
+    payload bytes
+    crc     u32   CRC32C(seed 0xFFFFFFFF) over type..payload
+
+The CRC uses the same Castagnoli core as everything else in the tree
+(host: native/ct_native.cc SSE4.2 path; device: ops/crc32c.py), so a
+frame captured on the wire can be batch-verified on TPU.
+"""
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass
+
+from .. import native
+
+MAGIC = 0x43545046
+_HDR = struct.Struct("<IHHI")
+CRC_SEED = 0xFFFFFFFF
+
+
+class FrameError(Exception):
+    pass
+
+
+@dataclass
+class Frame:
+    type: int
+    payload: bytes
+    flags: int = 0
+
+
+def encode_frame(f: Frame) -> bytes:
+    hdr = _HDR.pack(MAGIC, f.type, f.flags, len(f.payload))
+    crc = native.crc32c(hdr[4:] + f.payload, seed=CRC_SEED)
+    return hdr + f.payload + struct.pack("<I", crc)
+
+
+def decode_frame(buf: bytes | memoryview) -> tuple[Frame, int]:
+    """-> (frame, bytes consumed). Raises FrameError on corruption,
+    IncompleteFrame if more bytes are needed."""
+    if len(buf) < _HDR.size:
+        raise IncompleteFrame(_HDR.size)
+    magic, ftype, flags, length = _HDR.unpack_from(buf, 0)
+    if magic != MAGIC:
+        raise FrameError(f"bad magic {magic:#x}")
+    total = _HDR.size + length + 4
+    if len(buf) < total:
+        raise IncompleteFrame(total)
+    payload = bytes(buf[_HDR.size : _HDR.size + length])
+    (crc,) = struct.unpack_from("<I", buf, _HDR.size + length)
+    want = native.crc32c(bytes(buf[4 : _HDR.size + length]), seed=CRC_SEED)
+    if crc != want:
+        raise FrameError(f"crc mismatch {crc:#x} != {want:#x}")
+    return Frame(ftype, payload, flags), total
+
+
+class IncompleteFrame(FrameError):
+    """Need at least .needed bytes to decode."""
+
+    def __init__(self, needed: int):
+        super().__init__(f"need {needed} bytes")
+        self.needed = needed

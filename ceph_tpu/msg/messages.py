@@ -1,0 +1,133 @@
+"""Typed messages over denc: declarative fields, auto round-trip.
+
+The reference hand-writes encode_payload/decode_payload for 170 Message
+subclasses (src/messages/, e.g. MOSDOp.h:37). Here a message declares
+FIELDS = ((name, kind), ...) and the base class derives both directions
+from ceph_tpu.utils.denc — one source of truth per message, bounded
+decoding, no pickling.
+
+Kinds: u8 u16 u32 u64 i32 i64 str bytes, "list:<kind>", "map:<k>:<v>",
+"pair:<a>:<b>", or a (encode, decode) tuple for custom formats (decode
+takes (buf, off) -> (value, off)). Concrete messages live with their
+owning subsystem (mon/osd/client) and self-register; the registry maps
+frame type ids back to classes for dispatch.
+"""
+from __future__ import annotations
+
+from ..utils import denc
+
+_REGISTRY: dict[int, type["Message"]] = {}
+
+
+def _codec(kind):
+    if isinstance(kind, tuple):
+        return kind
+    if kind.startswith("list:"):
+        enc_i, dec_i = _codec(kind[5:])
+        return (
+            lambda v: denc.enc_list(v, enc_i),
+            lambda b, o: denc.dec_list(b, o, dec_i),
+        )
+    if kind.startswith("map:"):
+        k_kind, v_kind = kind[4:].split(":", 1)
+        enc_k, dec_k = _codec(k_kind)
+        enc_v, dec_v = _codec(v_kind)
+        return (
+            lambda d: denc.enc_map(d, enc_k, enc_v),
+            lambda b, o: denc.dec_map(b, o, dec_k, dec_v),
+        )
+    if kind.startswith("pair:"):
+        a_kind, b_kind = kind[5:].split(":", 1)
+        enc_a, dec_a = _codec(a_kind)
+        enc_b, dec_b = _codec(b_kind)
+
+        def enc(p):
+            return enc_a(p[0]) + enc_b(p[1])
+
+        def dec(buf, off):
+            a, off = dec_a(buf, off)
+            b, off = dec_b(buf, off)
+            return (a, b), off
+
+        return enc, dec
+    return {
+        "u8": (denc.enc_u8, denc.dec_u8),
+        "u16": (denc.enc_u16, denc.dec_u16),
+        "u32": (denc.enc_u32, denc.dec_u32),
+        "u64": (denc.enc_u64, denc.dec_u64),
+        "i32": (denc.enc_i32, denc.dec_i32),
+        "i64": (denc.enc_i64, denc.dec_i64),
+        "str": (denc.enc_str, denc.dec_str),
+        "bytes": (denc.enc_bytes, denc.dec_bytes),
+    }[kind]
+
+
+class Message:
+    """Base message; subclasses set TYPE (unique u16) and FIELDS."""
+
+    TYPE: int = 0
+    FIELDS: tuple = ()
+
+    def __init__(self, **kw):
+        names = [n for n, _ in self.FIELDS]
+        unknown = set(kw) - set(names)
+        if unknown:
+            raise TypeError(f"{type(self).__name__}: unknown fields {unknown}")
+        for n, _ in self.FIELDS:
+            if n not in kw:
+                raise TypeError(f"{type(self).__name__}: missing field {n!r}")
+            setattr(self, n, kw[n])
+
+    def encode(self) -> bytes:
+        out = []
+        for name, kind in self.FIELDS:
+            enc, _ = _codec(kind)
+            out.append(enc(getattr(self, name)))
+        return b"".join(out)
+
+    @classmethod
+    def decode(cls, buf: bytes, off: int = 0) -> "Message":
+        kw = {}
+        for name, kind in cls.FIELDS:
+            _, dec = _codec(kind)
+            kw[name], off = dec(buf, off)
+        if off != len(buf):
+            raise denc.DecodeError(
+                f"{cls.__name__}: {len(buf) - off} trailing bytes"
+            )
+        return cls(**kw)
+
+    def __repr__(self) -> str:
+        fields = ", ".join(
+            f"{n}={_short(getattr(self, n))}" for n, _ in self.FIELDS
+        )
+        return f"{type(self).__name__}({fields})"
+
+    def __eq__(self, other) -> bool:
+        return type(self) is type(other) and all(
+            getattr(self, n) == getattr(other, n) for n, _ in self.FIELDS
+        )
+
+
+def _short(v):
+    if isinstance(v, (bytes, bytearray)) and len(v) > 16:
+        return f"<{len(v)}B>"
+    r = repr(v)
+    return r if len(r) <= 48 else r[:45] + "..."
+
+
+def register_message(cls: type[Message]) -> type[Message]:
+    if cls.TYPE in _REGISTRY and _REGISTRY[cls.TYPE] is not cls:
+        raise ValueError(
+            f"message type {cls.TYPE} already bound to "
+            f"{_REGISTRY[cls.TYPE].__name__}"
+        )
+    _REGISTRY[cls.TYPE] = cls
+    return cls
+
+
+def decode_message(ftype: int, payload: bytes) -> Message:
+    cls = _REGISTRY.get(ftype)
+    if cls is None:
+        raise denc.DecodeError(f"unknown message type {ftype}")
+    return cls.decode(payload)

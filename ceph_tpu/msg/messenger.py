@@ -1,0 +1,158 @@
+"""Messengers: in-process LocalBus and asyncio TcpMessenger.
+
+Both speak the same CRC-framed wire format (frames.py) and the same
+envelope: payload = enc_str(src_entity) + msg bytes, frame.type = message
+type. Entities are reference-style names ("mon", "osd.3", "client.7").
+
+Design stance (vs src/msg/async/AsyncMessenger.h:74): one asyncio reactor
+per process instead of N event-loop threads + a lock hierarchy — the
+Crimson shared-nothing position (src/crimson/). Delivery per peer pair is
+in-order; the bus/TCP stream guarantees it the same way a lossless
+msgr2 connection does. Failed sends surface to the caller — like the
+reference's lossy client policy, retry/resend is an upper-layer concern
+(Objecter resends on map change; mon marks unreachable OSDs down).
+"""
+from __future__ import annotations
+
+import asyncio
+from typing import Awaitable, Callable
+
+from ..utils import denc
+from .frames import Frame, FrameError, IncompleteFrame, decode_frame, encode_frame
+from .messages import Message, decode_message
+
+Dispatcher = Callable[[str, Message], Awaitable[None]]
+
+
+class SendError(Exception):
+    pass
+
+
+class LocalBus:
+    """In-process router for cluster-free tests (direct_messenger role).
+
+    Every send still encodes to a frame and decodes back, so the wire
+    format is exercised by every test that uses the bus.
+    """
+
+    def __init__(self) -> None:
+        self.entities: dict[str, Dispatcher] = {}
+        self.dropped: list[tuple[str, str, Message]] = []
+        #: test hook: set of entity names that silently drop traffic
+        #: (blackhole_kill_osd analog, qa/tasks/ceph_manager.py:537)
+        self.blackholes: set[str] = set()
+        self._tasks: set[asyncio.Task] = set()
+
+    def register(self, name: str, dispatcher: Dispatcher) -> None:
+        self.entities[name] = dispatcher
+
+    def unregister(self, name: str) -> None:
+        self.entities.pop(name, None)
+        self.blackholes.discard(name)
+
+    async def send(self, src: str, dst: str, msg: Message) -> None:
+        wire = encode_frame(Frame(msg.TYPE, denc.enc_str(src) + msg.encode()))
+        frame, used = decode_frame(wire)
+        assert used == len(wire)
+        sender, off = denc.dec_str(frame.payload, 0)
+        decoded = decode_message(frame.type, frame.payload[off:])
+        if dst in self.blackholes or src in self.blackholes:
+            self.dropped.append((src, dst, decoded))
+            return
+        handler = self.entities.get(dst)
+        if handler is None:
+            raise SendError(f"no such entity {dst!r}")
+        # schedule, do not inline: senders never re-enter their own state
+        # under a peer's stack frame (the reference's fast_dispatch re-
+        # entrancy rules exist to manage exactly that)
+        task = asyncio.get_running_loop().create_task(handler(sender, decoded))
+        self._tasks.add(task)
+        task.add_done_callback(self._tasks.discard)
+
+    async def drain(self) -> None:
+        """Wait until every in-flight delivery (and what it spawned) ran."""
+        while self._tasks:
+            await asyncio.gather(*list(self._tasks), return_exceptions=False)
+            await asyncio.sleep(0)
+
+
+class TcpMessenger:
+    """Asyncio TCP messenger (PosixStack role), one per entity.
+
+    Peers are located through an address book {entity: (host, port)} —
+    the role the reference's maps' addrvecs play. Outgoing connections
+    are cached and re-dialed on failure.
+    """
+
+    def __init__(self, name: str, dispatcher: Dispatcher):
+        self.name = name
+        self.dispatcher = dispatcher
+        self.addrbook: dict[str, tuple[str, int]] = {}
+        self._conns: dict[str, asyncio.StreamWriter] = {}
+        self._server: asyncio.AbstractServer | None = None
+        self._readers: set[asyncio.Task] = set()
+
+    async def listen(self, host: str = "127.0.0.1", port: int = 0) -> tuple[str, int]:
+        self._server = await asyncio.start_server(self._accept, host, port)
+        addr = self._server.sockets[0].getsockname()[:2]
+        return addr
+
+    async def close(self) -> None:
+        if self._server:
+            self._server.close()
+            await self._server.wait_closed()
+        for w in self._conns.values():
+            w.close()
+        self._conns.clear()
+        for t in self._readers:
+            t.cancel()
+
+    async def _accept(self, reader, writer) -> None:
+        task = asyncio.current_task()
+        self._readers.add(task)
+        try:
+            await self._read_loop(reader)
+        except (asyncio.IncompleteReadError, ConnectionError):
+            pass
+        finally:
+            self._readers.discard(task)
+            writer.close()
+
+    async def _read_loop(self, reader: asyncio.StreamReader) -> None:
+        buf = b""
+        while True:
+            try:
+                frame, used = decode_frame(buf)
+            except IncompleteFrame as need:
+                chunk = await reader.read(max(need.needed - len(buf), 4096))
+                if not chunk:
+                    return
+                buf += chunk
+                continue
+            except FrameError:
+                raise ConnectionError("corrupt frame")
+            buf = buf[used:]
+            sender, off = denc.dec_str(frame.payload, 0)
+            msg = decode_message(frame.type, frame.payload[off:])
+            await self.dispatcher(sender, msg)
+
+    async def send(self, dst: str, msg: Message) -> None:
+        wire = encode_frame(
+            Frame(msg.TYPE, denc.enc_str(self.name) + msg.encode())
+        )
+        writer = self._conns.get(dst)
+        if writer is None or writer.is_closing():
+            if dst not in self.addrbook:
+                raise SendError(f"no address for {dst!r}")
+            host, port = self.addrbook[dst]
+            try:
+                _, writer = await asyncio.open_connection(host, port)
+            except OSError as e:
+                raise SendError(f"connect to {dst} failed: {e}") from e
+            self._conns[dst] = writer
+        try:
+            writer.write(wire)
+            await writer.drain()
+        except (ConnectionError, OSError) as e:
+            self._conns.pop(dst, None)
+            raise SendError(f"send to {dst} failed: {e}") from e

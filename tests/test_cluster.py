@@ -1,0 +1,281 @@
+"""Cluster integration: the SURVEY §7 minimum end-to-end slice and the
+thrash scenarios (kill/revive/blackhole) of the qa tier, in-process.
+
+Every test assembles mon + OSDs + client on a LocalBus; the EC pool path
+runs striped writes through the batched device encode (on the virtual
+CPU mesh under pytest) and repairs through minimum_to_decode + decode —
+the ECBackend.cc:1539/2405 arc end to end.
+"""
+import asyncio
+
+import numpy as np
+import pytest
+
+from ceph_tpu.cluster import TestCluster
+from ceph_tpu.cluster.pg import NONE
+from ceph_tpu.placement.osdmap import Pool
+
+EC_PROFILE = {"plugin": "rs_tpu", "k": "3", "m": "2", "backend": "device"}
+
+
+def run(coro):
+    asyncio.run(asyncio.wait_for(coro, 120))
+
+
+async def make_cluster(n=5):
+    c = TestCluster(n_osds=n)
+    await c.start()
+    return c
+
+
+async def make_ec_cluster(n=5):
+    c = await make_cluster(n)
+    await c.client.create_pool(
+        Pool(id=2, name="ec", size=5, min_size=3, pg_num=8, crush_rule=1,
+             type="erasure", ec_profile=dict(EC_PROFILE))
+    )
+    await c.wait_active(20)
+    return c
+
+
+def test_boot_and_health():
+    async def t():
+        c = await make_cluster(4)
+        assert all(st.up for st in c.mon.osdmap.osds)
+        await c.stop()
+
+    run(t())
+
+
+def test_replicated_write_read_delete():
+    async def t():
+        c = await make_cluster(4)
+        await c.client.create_pool(
+            Pool(id=1, name="rep", size=3, pg_num=8, crush_rule=0)
+        )
+        await c.wait_active(20)
+        payload = b"the quick brown fox" * 123
+        await c.client.write_full(1, "obj", payload)
+        assert await c.client.read(1, "obj") == payload
+        assert await c.client.stat(1, "obj") == len(payload)
+        # overwrite bumps the version and replaces content everywhere
+        await c.client.write_full(1, "obj", b"short")
+        assert await c.client.read(1, "obj") == b"short"
+        await c.client.delete(1, "obj")
+        with pytest.raises(KeyError):
+            await c.client.read(1, "obj")
+        await c.stop()
+
+    run(t())
+
+
+def test_replicated_survives_replica_loss():
+    async def t():
+        c = await make_cluster(4)
+        await c.client.create_pool(
+            Pool(id=1, name="rep", size=3, pg_num=8, crush_rule=0)
+        )
+        await c.wait_active(20)
+        await c.client.write_full(1, "obj", b"D" * 4096)
+        pgid = c.client.osdmap.object_to_pg(1, b"obj")
+        up, primary = c.mon.osdmap.pg_to_up_acting_osds(pgid)
+        replica = next(o for o in up if o != primary)
+        await c.kill_osd(replica)
+        await c.wait_down(replica, 20)
+        assert await c.client.read(1, "obj") == b"D" * 4096
+        # failure detection produced a new epoch marking it down
+        assert not c.mon.osdmap.osds[replica].up
+        await c.stop()
+
+    run(t())
+
+
+def test_replicated_primary_loss_client_resends():
+    async def t():
+        c = await make_cluster(5)
+        await c.client.create_pool(
+            Pool(id=1, name="rep", size=3, pg_num=8, crush_rule=0)
+        )
+        await c.wait_active(20)
+        await c.client.write_full(1, "obj", b"P" * 1000)
+        pgid = c.client.osdmap.object_to_pg(1, b"obj")
+        _, primary = c.mon.osdmap.pg_to_up_acting_osds(pgid)
+        await c.kill_osd(primary)
+        await c.wait_down(primary, 20)
+        await c.wait_active(20)
+        # Objecter recalculates the target from the new map and resends
+        assert await c.client.read(1, "obj") == b"P" * 1000
+        await c.stop()
+
+    run(t())
+
+
+def test_ec_write_read_unaligned():
+    async def t():
+        c = await make_ec_cluster()
+        data = bytes(range(256)) * 37  # 9472 B: pads within the stripe
+        await c.client.write_full(2, "obj", data)
+        assert await c.client.read(2, "obj") == data
+        assert await c.client.stat(2, "obj") == len(data)
+        # every live shard holds a chunk with a valid hinfo CRC
+        pgid = c.client.osdmap.object_to_pg(2, b"obj")
+        up, _ = c.mon.osdmap.pg_to_up_acting_osds(pgid)
+        held = 0
+        for shard, osd_id in enumerate(up):
+            if osd_id == NONE:
+                continue
+            store = c.stores[osd_id]
+            cid = f"{pgid[0]}.{pgid[1]}s{shard}"
+            if store.exists(cid, b"obj"):
+                held += 1
+        assert held == 5
+        await c.stop()
+
+    run(t())
+
+
+def test_ec_degraded_read_two_losses():
+    async def t():
+        c = await make_ec_cluster()
+        data = np.random.default_rng(3).integers(
+            0, 256, 3 * 4096, dtype=np.uint8
+        ).tobytes()
+        await c.client.write_full(2, "obj", data)
+        pgid = c.client.osdmap.object_to_pg(2, b"obj")
+        up, primary = c.mon.osdmap.pg_to_up_acting_osds(pgid)
+        victims = [o for o in up if o != primary][:2]
+        for v in victims:
+            await c.kill_osd(v)
+            await c.wait_down(v, 20)
+        # k=3 of 5 shards remain: reconstruct on read, bit-exact
+        assert await c.client.read(2, "obj") == data
+        await c.stop()
+
+    run(t())
+
+
+def test_ec_recovery_on_revive():
+    async def t():
+        c = await make_ec_cluster()
+        datas = {f"o{i}": bytes([i]) * (1024 * (i + 1)) for i in range(4)}
+        for name, d in datas.items():
+            await c.client.write_full(2, name, d)
+        # find an OSD holding shards of pg of o0; kill it, write more,
+        # revive: the PGLog delta drives chunk reconstruction pushes
+        pgid = c.client.osdmap.object_to_pg(2, b"o0")
+        up, primary = c.mon.osdmap.pg_to_up_acting_osds(pgid)
+        victim = next(o for o in up if o != primary)
+        shard = up.index(victim)
+        await c.kill_osd(victim)
+        await c.wait_down(victim, 20)
+        await c.client.write_full(2, "o0", b"NEW" * 2048)  # degraded write
+        await c.revive_osd(victim)
+        await c.wait_active(30)
+        # revived shard must converge: its chunk decodes with the rest
+        assert await c.client.read(2, "o0") == b"NEW" * 2048
+
+        # the revived OSD's own shard was re-reconstructed bit-exact:
+        # kill two OTHER members and force a read that needs it
+        up2, primary2 = c.mon.osdmap.pg_to_up_acting_osds(pgid)
+        others = [o for o in up2
+                  if o not in (victim, primary2) and o != NONE][:2]
+        for o in others:
+            await c.kill_osd(o)
+            await c.wait_down(o, 20)
+        assert await c.client.read(2, "o0") == b"NEW" * 2048
+        await c.stop()
+
+    run(t())
+
+
+def test_replicated_delta_recovery_and_delete():
+    async def t():
+        c = await make_cluster(4)
+        await c.client.create_pool(
+            Pool(id=1, name="rep", size=3, pg_num=4, crush_rule=0)
+        )
+        await c.wait_active(20)
+        for i in range(6):
+            await c.client.write_full(1, f"k{i}", b"x" * 512 + bytes([i]))
+        pgid = c.client.osdmap.object_to_pg(1, b"k0")
+        up, primary = c.mon.osdmap.pg_to_up_acting_osds(pgid)
+        victim = next(o for o in up if o != primary)
+        await c.kill_osd(victim)
+        await c.wait_down(victim, 20)
+        await c.client.write_full(1, "k0", b"fresh")
+        await c.client.delete(1, "k1")
+        await c.revive_osd(victim)
+        await c.wait_active(30)
+        store = c.stores[victim]
+        cid = f"{pgid[0]}.{pgid[1]}"
+        # recovered write visible, recovered delete applied
+        if store.exists(cid, b"k0"):
+            assert bytes(store.read(cid, b"k0")) == b"fresh"
+            assert not store.exists(cid, b"k1")
+        assert await c.client.read(1, "k0") == b"fresh"
+        with pytest.raises(KeyError):
+            await c.client.read(1, "k1")
+        await c.stop()
+
+    run(t())
+
+
+def test_backfill_after_log_trim():
+    async def t():
+        c = TestCluster(n_osds=4)
+        await c.start()
+        await c.client.create_pool(
+            Pool(id=1, name="rep", size=3, pg_num=1, crush_rule=0)
+        )
+        await c.wait_active(20)
+        for o in c.osds:
+            if o is not None:
+                o.log_keep = 4  # tiny logs force the backfill path
+        await c.client.write_full(1, "base", b"B")
+        up, primary = c.mon.osdmap.pg_to_up_acting_osds((1, 0))
+        victim = next(o for o in up if o != primary)
+        await c.kill_osd(victim)
+        await c.wait_down(victim, 20)
+        # push far more writes than the log keeps -> delta impossible
+        for i in range(12):
+            await c.client.write_full(1, f"n{i}", bytes([i]) * 128)
+        o = await c.revive_osd(victim)
+        o.log_keep = 4
+        await c.wait_active(30)
+        store = c.stores[victim]
+        have = set(store.list_objects("1.0")) - {b"_pgmeta"}
+        assert {f"n{i}".encode() for i in range(12)} <= have
+        await c.stop()
+
+    run(t())
+
+
+def test_mark_out_replaces_member():
+    async def t():
+        c = TestCluster(n_osds=5, out_interval=1.0)
+        await c.start()
+        await c.client.create_pool(
+            Pool(id=1, name="rep", size=3, pg_num=4, crush_rule=0)
+        )
+        await c.wait_active(20)
+        await c.client.write_full(1, "obj", b"keepme" * 100)
+        pgid = c.client.osdmap.object_to_pg(1, b"obj")
+        up, primary = c.mon.osdmap.pg_to_up_acting_osds(pgid)
+        victim = next(o for o in up if o != primary)
+        await c.kill_osd(victim)
+        await c.wait_down(victim, 20)
+
+        async def wait_out():
+            while c.mon.osdmap.osds[victim].weight != 0:
+                await asyncio.sleep(0.05)
+        await asyncio.wait_for(wait_out(), 30)
+        await c.wait_active(30)
+        up2, _ = c.mon.osdmap.pg_to_up_acting_osds(pgid)
+        assert victim not in up2 and len([o for o in up2 if o != NONE]) == 3
+        # the replacement member was backfilled
+        assert await c.client.read(1, "obj") == b"keepme" * 100
+        newcomer = next(o for o in up2 if o not in up)
+        assert c.stores[newcomer].exists(f"{pgid[0]}.{pgid[1]}", b"obj")
+        await c.stop()
+
+    run(t())
