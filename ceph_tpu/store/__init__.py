@@ -9,9 +9,9 @@ atomically by ``queue_transaction`` (ObjectStore.h:223).
 Implementations:
 - ``MemStore`` (memstore.py) — dict-backed test double, the reference's
   src/os/memstore role; used by OSD-lite processes and tests.
-- ``FileStoreLite`` (filestore.py) — persistent single-file store with a
-  write-ahead log and batched CRC32C blob checksums through the device
-  Checksummer path (the BlueStore-shaped store).
+- ``WalStore`` (walstore.py) — persistent directory-backed store with a
+  CRC-framed write-ahead log, checkpoint snapshots, and batched CRC32C
+  blob checksums through the Checksummer (the BlueStore-shaped store).
 
 Factory: ``create(kind, path)`` mirroring ObjectStore::create
 (src/os/ObjectStore.cc:30-62).
@@ -27,8 +27,10 @@ def create(kind: str, path: str | None = None, **kw) -> ObjectStore:
     """ObjectStore::create-style factory (os/ObjectStore.cc:30)."""
     if kind == "memstore":
         return MemStore()
-    if kind == "filestore":
-        from .filestore import FileStoreLite
+    if kind in ("walstore", "filestore", "bluestore"):
+        from .walstore import WalStore
 
-        return FileStoreLite(path, **kw)
+        s = WalStore(path, **kw)
+        s.mount()
+        return s
     raise ValueError(f"unknown store kind {kind!r}")

@@ -24,8 +24,15 @@ class MemStore(ObjectStore):
         self, t: tx.Transaction, on_commit: Callable[[], None] | None = None
     ) -> None:
         with self.lock:
-            # all-or-nothing: run against a shallow copy of the coll map
-            # with cloned touched collections; commit by swap
+            self.colls = self._apply_to_shadow(t)
+        if on_commit:
+            on_commit()
+
+    def _apply_to_shadow(self, t: tx.Transaction) -> dict[str, Collection]:
+        """All-or-nothing staging: run the ops against a shallow copy of
+        the coll map with cloned touched collections; the caller commits
+        by swapping the returned map in (under self.lock)."""
+        with self.lock:
             touched = {op.cid for op in t.ops}
             shadow = dict(self.colls)
             for cid in touched:
@@ -37,9 +44,7 @@ class MemStore(ObjectStore):
                     shadow[cid] = c
             for op in t.ops:
                 self._do_op(shadow, op)
-            self.colls = shadow
-        if on_commit:
-            on_commit()
+            return shadow
 
     # -------------------------------------------------------------- reads
 

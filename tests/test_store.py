@@ -1,0 +1,320 @@
+"""Store layer tests: Transaction wire form, MemStore op conformance,
+WalStore durability (the store_test.cc role, src/test/objectstore/
+store_test.cc, run against every backend the same way the reference's
+StoreTest is parameterized over memstore/bluestore)."""
+import os
+
+import numpy as np
+import pytest
+
+from ceph_tpu import native
+from ceph_tpu.store import NotFound, StoreError
+from ceph_tpu.store import transaction as tx
+from ceph_tpu.store.memstore import MemStore
+from ceph_tpu.store.walstore import WalStore
+from ceph_tpu.utils import denc
+
+
+def all_op_txn() -> tx.Transaction:
+    """One transaction touching every opcode (order matters)."""
+    t = tx.Transaction()
+    t.create_collection("c")
+    t.touch("c", b"a")
+    t.write("c", b"a", 0, b"hello world")
+    t.zero("c", b"a", 5, 3)
+    t.truncate("c", b"a", 8)
+    t.setattr("c", b"a", "k1", b"v1")
+    t.setattrs("c", b"a", {"k2": b"v2", "k3": b"v3"})
+    t.rmattr("c", b"a", "k3")
+    t.clone("c", b"a", b"b")
+    t.clone_range("c", b"a", b"r", 2, 4, 1)
+    t.omap_setheader("c", b"a", b"HDR")
+    t.omap_setkeys("c", b"a", {b"x": b"1", b"y": b"2", b"z": b"3"})
+    t.omap_rmkeys("c", b"a", [b"z"])
+    t.omap_rmkeyrange("c", b"a", b"y", b"yz")
+    t.touch("c", b"tmp")
+    t.remove("c", b"tmp")
+    t.create_collection("dead")
+    t.remove_collection("dead")
+    return t
+
+
+def check_all_op_state(s, extra_colls=()):
+    # write "hello world" -> zero [5,8) -> truncate 8 = "hello\0\0\0"
+    assert s.read("c", b"a") == b"hello\0\0\0"
+    assert s.stat("c", b"a") == 8
+    assert s.getattr("c", b"a", "k1") == b"v1"
+    assert s.getattrs("c", b"a") == {"k1": b"v1", "k2": b"v2"}
+    # clone happened after attrs/truncate but before omap writes
+    assert s.read("c", b"b") == b"hello\0\0\0"
+    assert s.getattrs("c", b"b") == {"k1": b"v1", "k2": b"v2"}
+    # clone_range: src[2:6] = "llo\0" written at dst_off 1
+    assert s.read("c", b"r") == b"\0llo\0"
+    assert s.omap_get_header("c", b"a") == b"HDR"
+    assert s.omap_get("c", b"a") == {b"x": b"1"}
+    assert not s.exists("c", b"tmp")
+    assert s.list_collections() == sorted(["c", *extra_colls])
+    assert s.list_objects("c") == [b"a", b"b", b"r"]
+
+
+def test_transaction_encode_roundtrip():
+    t = all_op_txn()
+    blob = t.encode()
+    t2, off = tx.Transaction.decode(blob)
+    assert off == len(blob)
+    assert len(t2) == len(t)
+    for a, b in zip(t.ops, t2.ops):
+        assert (a.code, a.cid, a.oid, a.args) == (b.code, b.cid, b.oid, b.args)
+
+
+def test_memstore_all_opcodes():
+    s = MemStore()
+    s.apply_transaction(all_op_txn())
+    check_all_op_state(s)
+
+
+def test_memstore_atomicity():
+    """A failing op rolls back the whole transaction (all-or-nothing,
+    the do_transaction contract)."""
+    s = MemStore()
+    t = tx.Transaction()
+    t.create_collection("c")
+    t.write("c", b"a", 0, b"first")
+    s.apply_transaction(t)
+
+    bad = tx.Transaction()
+    bad.write("c", b"a", 0, b"SECOND")
+    bad.remove("c", b"nonexistent")  # raises NotFound
+    with pytest.raises(NotFound):
+        s.queue_transaction(bad)
+    assert s.read("c", b"a") == b"first"  # first op rolled back too
+
+    bad2 = tx.Transaction()
+    bad2.write("c", b"a", 0, b"X")
+    bad2.remove_collection("c")  # not empty -> StoreError
+    with pytest.raises(StoreError):
+        s.queue_transaction(bad2)
+    assert s.read("c", b"a") == b"first"
+
+
+def test_memstore_errors():
+    s = MemStore()
+    with pytest.raises(NotFound):
+        s.read("nope", b"x")
+    t = tx.Transaction().create_collection("c")
+    s.apply_transaction(t)
+    with pytest.raises(NotFound):
+        s.read("c", b"x")
+    with pytest.raises(NotFound):
+        s.getattr("c", b"x", "a")
+    t2 = tx.Transaction().create_collection("c")
+    with pytest.raises(StoreError):
+        s.queue_transaction(t2)  # duplicate collection
+
+
+def test_denc_roundtrips():
+    assert denc.dec_u8(denc.enc_u8(0xAB), 0) == (0xAB, 1)
+    assert denc.dec_u16(denc.enc_u16(0xABCD), 0) == (0xABCD, 2)
+    assert denc.dec_u32(denc.enc_u32(0xDEADBEEF), 0) == (0xDEADBEEF, 4)
+    assert denc.dec_u64(denc.enc_u64(2**61 + 5), 0) == (2**61 + 5, 8)
+    assert denc.dec_i32(denc.enc_i32(-7), 0) == (-7, 4)
+    assert denc.dec_i64(denc.enc_i64(-(2**40)), 0) == (-(2**40), 8)
+    assert denc.dec_bytes(denc.enc_bytes(b"abc"), 0) == (b"abc", 7)
+    assert denc.dec_str(denc.enc_str("héllo"), 0)[0] == "héllo"
+    xs = [b"a", b"bb", b""]
+    assert denc.dec_list(denc.enc_list(xs, denc.enc_bytes), 0,
+                         denc.dec_bytes)[0] == xs
+    d = {b"k": b"v", b"": b"x"}
+    assert denc.dec_map(denc.enc_map(d, denc.enc_bytes, denc.enc_bytes),
+                        0, denc.dec_bytes, denc.dec_bytes)[0] == d
+    with pytest.raises(denc.DecodeError):
+        denc.dec_u32(b"\x01\x02", 0)  # truncated
+
+
+# ------------------------------------------------------------- WalStore
+
+
+def make_walstore(tmp_path, **kw) -> WalStore:
+    s = WalStore(str(tmp_path / "store"), **kw)
+    s.mount()
+    return s
+
+
+def test_walstore_all_opcodes(tmp_path):
+    s = make_walstore(tmp_path)
+    s.apply_transaction(all_op_txn())
+    check_all_op_state(s)
+    s.umount()
+
+
+def test_walstore_replay_after_crash(tmp_path):
+    """kill -9 mid-life: reopen WITHOUT umount; WAL replay must restore
+    everything (the BlueStore deferred-replay contract)."""
+    s = make_walstore(tmp_path)
+    s.apply_transaction(all_op_txn())
+    t = tx.Transaction().create_collection("c2")
+    t.write("c2", b"late", 0, b"not checkpointed")
+    s.apply_transaction(t)
+    # no umount: simulates SIGKILL (state only in WAL, no snapshot)
+    s2 = make_walstore(tmp_path)
+    check_all_op_state(s2, extra_colls=["c2"])
+    assert s2.read("c2", b"late") == b"not checkpointed"
+    s2.umount()
+
+
+def test_walstore_snapshot_plus_wal(tmp_path):
+    s = make_walstore(tmp_path)
+    s.apply_transaction(all_op_txn())
+    s.compact()  # snapshot; WAL truncated
+    t = tx.Transaction().create_collection("c2")
+    t.write("c2", b"post", 0, b"after snap")
+    s.apply_transaction(t)
+    s2 = make_walstore(tmp_path)  # crash-reopen: snapshot + 1 WAL record
+    check_all_op_state(s2, extra_colls=["c2"])
+    assert s2.read("c2", b"post") == b"after snap"
+    s2.umount()
+
+
+def test_walstore_torn_tail(tmp_path):
+    """A record cut mid-append (torn write) is discarded; every record
+    before it survives."""
+    s = make_walstore(tmp_path)
+    t1 = tx.Transaction().create_collection("c")
+    t1.write("c", b"a", 0, b"durable")
+    s.apply_transaction(t1)
+    t2 = tx.Transaction().write("c", b"a", 0, b"torn away")
+    s.apply_transaction(t2)
+    wal = os.path.join(s.path, "wal.log")
+    size = os.path.getsize(wal)
+    with open(wal, "r+b") as f:
+        f.truncate(size - 3)  # cut into the last record
+    s2 = make_walstore(tmp_path)
+    assert s2.read("c", b"a") == b"durable"
+    s2.umount()
+
+
+def test_walstore_corrupt_tail_crc(tmp_path):
+    s = make_walstore(tmp_path)
+    t1 = tx.Transaction().create_collection("c")
+    t1.write("c", b"a", 0, b"good")
+    s.apply_transaction(t1)
+    t2 = tx.Transaction().write("c", b"b", 0, b"flipped")
+    s.apply_transaction(t2)
+    wal = os.path.join(s.path, "wal.log")
+    with open(wal, "r+b") as f:
+        f.seek(-1, os.SEEK_END)
+        last = f.read(1)
+        f.seek(-1, os.SEEK_END)
+        f.write(bytes([last[0] ^ 0xFF]))
+    s2 = make_walstore(tmp_path)
+    assert s2.read("c", b"a") == b"good"
+    assert not s2.exists("c", b"b")  # corrupt record dropped
+    s2.umount()
+
+
+def test_walstore_torn_tail_then_more_writes(tmp_path):
+    """Mount must truncate a torn tail before appending: records written
+    after the first crash stay reachable across a second crash."""
+    s = make_walstore(tmp_path)
+    t1 = tx.Transaction().create_collection("c")
+    t1.write("c", b"a", 0, b"one")
+    s.apply_transaction(t1)
+    s.apply_transaction(tx.Transaction().write("c", b"a", 0, b"gone"))
+    wal = os.path.join(s.path, "wal.log")
+    with open(wal, "r+b") as f:
+        f.truncate(os.path.getsize(wal) - 2)  # tear the second record
+    s2 = make_walstore(tmp_path)  # crash-reopen #1
+    assert s2.read("c", b"a") == b"one"
+    t2 = tx.Transaction().write("c", b"b", 0, b"two")
+    s2.apply_transaction(t2)
+    s3 = make_walstore(tmp_path)  # crash-reopen #2
+    assert s3.read("c", b"a") == b"one"
+    assert s3.read("c", b"b") == b"two"
+    s3.umount()
+
+
+def test_walstore_crash_inside_compact(tmp_path):
+    """Crash between snapshot publish and WAL truncate: replay must skip
+    the pre-snapshot records (seq watermark), not double-apply them."""
+    s = make_walstore(tmp_path)
+    t = tx.Transaction().create_collection("c")
+    t.write("c", b"a", 0, b"v1")
+    s.apply_transaction(t)
+    s.apply_transaction(tx.Transaction().write("c", b"a", 0, b"v2"))
+    # simulate the torn compact: publish the snapshot but leave the WAL
+    snap_blob = s._encode_snapshot()
+    with open(os.path.join(s.path, "snap"), "wb") as f:
+        f.write(snap_blob)
+    s2 = make_walstore(tmp_path)  # crash-reopen
+    assert s2.read("c", b"a") == b"v2"
+    # and it keeps working: new writes land after the stale records
+    s2.apply_transaction(tx.Transaction().write("c", b"a", 0, b"v3"))
+    s3 = make_walstore(tmp_path)
+    assert s3.read("c", b"a") == b"v3"
+    s3.umount()
+
+
+def test_walstore_snapshot_csum_detects_corruption(tmp_path):
+    """Blob checksums (calc_csum/verify_csum role) catch bit rot in the
+    checkpoint file."""
+    s = make_walstore(tmp_path)
+    t = tx.Transaction().create_collection("c")
+    t.write("c", b"a", 0, b"Z" * 10000)
+    s.apply_transaction(t)
+    s.umount()  # compacts -> snapshot holds the data
+    snap = os.path.join(str(tmp_path / "store"), "snap")
+    blob = bytearray(open(snap, "rb").read())
+    idx = blob.find(b"Z" * 100)
+    assert idx > 0
+    blob[idx + 50] ^= 0x01
+    open(snap, "wb").write(bytes(blob))
+    s2 = WalStore(str(tmp_path / "store"))
+    with pytest.raises(StoreError, match="csum mismatch"):
+        s2.mount()
+
+
+def test_walstore_rejected_txn_not_logged(tmp_path):
+    """A transaction that fails validation must not pollute the WAL."""
+    s = make_walstore(tmp_path)
+    t = tx.Transaction().create_collection("c")
+    t.write("c", b"a", 0, b"ok")
+    s.apply_transaction(t)
+    bad = tx.Transaction().remove("c", b"ghost")
+    with pytest.raises(NotFound):
+        s.queue_transaction(bad)
+    s2 = make_walstore(tmp_path)  # crash-reopen replays the log
+    assert s2.read("c", b"a") == b"ok"
+    s2.umount()
+
+
+def test_walstore_auto_compact(tmp_path):
+    s = WalStore(str(tmp_path / "store"), wal_compact_bytes=256)
+    s.mount()
+    t = tx.Transaction().create_collection("c")
+    s.apply_transaction(t)
+    for i in range(20):
+        t = tx.Transaction().write("c", b"o%d" % i, 0, b"x" * 64)
+        s.apply_transaction(t)
+    if s._compactor is not None:
+        s._compactor.join()  # compaction runs off the commit thread
+    assert os.path.getsize(os.path.join(s.path, "wal.log")) < 4096
+    assert os.path.exists(os.path.join(s.path, "snap"))
+    s2 = make_walstore(tmp_path)
+    for i in range(20):
+        assert s2.read("c", b"o%d" % i) == b"x" * 64
+    s2.umount()
+
+
+def test_walstore_empty_object_and_omap_snapshot(tmp_path):
+    s = make_walstore(tmp_path)
+    t = tx.Transaction().create_collection("c")
+    t.touch("c", b"empty")
+    t.omap_setkeys("c", b"empty", {b"k": b"v"})
+    t.omap_setheader("c", b"empty", b"H")
+    s.apply_transaction(t)
+    s.umount()
+    s2 = make_walstore(tmp_path)
+    assert s2.stat("c", b"empty") == 0
+    assert s2.omap_get("c", b"empty") == {b"k": b"v"}
+    assert s2.omap_get_header("c", b"empty") == b"H"
+    s2.umount()
