@@ -15,6 +15,7 @@ EVERSION = "pair:u32:u64"
 # op result codes (negated errno style, like the reference)
 OK = 0
 ENOENT = -2
+EIO = -5
 EAGAIN = -11
 ESTALE = -116
 
@@ -232,3 +233,56 @@ class MPGScanReply(Message):
     TYPE = 46
     FIELDS = (("pgid", PGID), ("shard", "i32"),
               ("objects", "map:bytes:" + EVERSION))
+
+
+# ------------------------------------------------------------------ scrub
+
+
+@register_message
+class MScrub(Message):
+    TYPE = 50
+    # "digest every object you hold for pgid" (scrub_machine replica
+    # map request role)
+    FIELDS = (("pgid", PGID), ("shard", "i32"), ("epoch", "u32"),
+              ("tid", "u64"))
+
+
+def _enc_scrub_entry(e):
+    from ..utils import denc
+
+    (epoch, seq), (size, crc) = e
+    return (denc.enc_u32(epoch) + denc.enc_u64(seq)
+            + denc.enc_u64(size) + denc.enc_u32(crc))
+
+
+def _dec_scrub_entry(buf, off):
+    from ..utils import denc
+
+    epoch, off = denc.dec_u32(buf, off)
+    seq, off = denc.dec_u64(buf, off)
+    size, off = denc.dec_u64(buf, off)
+    crc, off = denc.dec_u32(buf, off)
+    return ((epoch, seq), (size, crc)), off
+
+
+def _enc_scrub_map(d):
+    from ..utils import denc
+
+    return denc.enc_map(d, denc.enc_bytes, _enc_scrub_entry)
+
+
+def _dec_scrub_map(buf, off):
+    from ..utils import denc
+
+    return denc.dec_map(buf, off, denc.dec_bytes, _dec_scrub_entry)
+
+
+@register_message
+class MScrubReply(Message):
+    TYPE = 51
+    # oid -> ((epoch, seq), (size, data crc32c)) — the ScrubMap role.
+    # errors: oids whose chunk bytes fail the member's own stored-hinfo
+    # check (EC deep-scrub self-verification)
+    FIELDS = (("pgid", PGID), ("shard", "i32"), ("tid", "u64"),
+              ("objects", (_enc_scrub_map, _dec_scrub_map)),
+              ("errors", "list:bytes"))

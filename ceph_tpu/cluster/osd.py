@@ -23,6 +23,7 @@ import numpy as np
 from ..ec import load_codec
 from ..placement import encoding as menc
 from ..store.memstore import MemStore
+from ..utils.fault import FaultInjector
 from . import messages as M
 from .pg import NONE, PG
 
@@ -101,6 +102,7 @@ class OSDLite:
         self.subop_timeout = subop_timeout
         self.log_keep = log_keep
         self.ec_batcher = ECBatcher()
+        self.fault = FaultInjector()
         self.pending: dict = {}  # key -> Future (sub-op replies)
         self._subtid = 0
         self._codecs: dict[int, object] = {}
@@ -134,6 +136,12 @@ class OSDLite:
                 except Exception:
                     pass
             raise
+
+    @property
+    def epoch(self) -> int:
+        """Map epoch, 0 before the first map arrives (a revived OSD can
+        see peering traffic before its MOSDBoot round-trip completes)."""
+        return self.osdmap.epoch if self.osdmap is not None else 0
 
     def new_subtid(self) -> int:
         self._subtid += 1
@@ -209,8 +217,7 @@ class OSDLite:
             try:
                 await self.bus.send(
                     self.name, "mon",
-                    M.MPing(osd=self.id,
-                            epoch=self.osdmap.epoch if self.osdmap else 0),
+                    M.MPing(osd=self.id, epoch=self.epoch),
                 )
             except Exception:
                 pass
@@ -235,9 +242,7 @@ class OSDLite:
                 await self.send(
                     src,
                     M.MOSDOpReply(tid=msg.tid, result=M.ESTALE, data=b"",
-                                  size=0,
-                                  epoch=self.osdmap.epoch if self.osdmap
-                                  else 0),
+                                  size=0, epoch=self.epoch),
                 )
                 return
             await pg.do_op(src, msg)
@@ -288,6 +293,11 @@ class OSDLite:
             osd_id = int(src[4:])
             self._resolve(("pushr", msg.pgid, msg.shard, msg.oid, osd_id),
                           msg)
+        elif isinstance(msg, M.MScrub):
+            pg = self._ensure_pg(msg.pgid, msg.shard)
+            await pg.handle_scrub(src, msg)
+        elif isinstance(msg, M.MScrubReply):
+            self._resolve(msg.tid, msg)
 
     def _my_shard(self, pgid, msg_shard: int) -> int:
         """The shard *this* OSD holds for pgid (push messages carry the
@@ -336,9 +346,7 @@ class OSDLite:
                 if self.osdmap is not None and inc.epoch <= self.osdmap.epoch:
                     continue
                 await self.bus.send(
-                    self.name, "mon",
-                    M.MMonGetMap(have=self.osdmap.epoch if self.osdmap
-                                 else 0),
+                    self.name, "mon", M.MMonGetMap(have=self.epoch)
                 )
                 return
             self.osdmap.apply_incremental(inc)

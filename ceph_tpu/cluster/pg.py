@@ -31,6 +31,7 @@ from typing import TYPE_CHECKING
 import numpy as np
 
 from .. import native
+from ..store import NotFound
 from ..store import transaction as tx
 from ..utils import denc
 from . import messages as M
@@ -204,7 +205,7 @@ class PG:
             else:
                 reply = M.MOSDOpReply(tid=m.tid, result=M.EAGAIN, data=b"",
                                       size=0, epoch=self.osd.osdmap.epoch)
-        except KeyError:
+        except (KeyError, NotFound):
             reply = M.MOSDOpReply(tid=m.tid, result=M.ENOENT, data=b"",
                                   size=0, epoch=self.osd.osdmap.epoch)
         except Exception:
@@ -355,43 +356,73 @@ class PG:
 
         The objects_read_and_reconstruct role (ECBackend.cc:2405):
         minimum_to_decode picks the fetch set from available shards,
-        sub-reads verify hinfo CRCs, decode rebuilds missing data chunks.
-        """
+        sub-reads verify hinfo CRCs, decode rebuilds missing data
+        chunks. A failed sub-read (EIO, hinfo mismatch, lost chunk)
+        excludes that shard and re-plans the fetch set from survivors —
+        the reconstruct-on-read arc of test-erasure-eio.sh."""
         codec = self.osd.codec_for(self.pool)
         k = codec.k
         live = {s: o for o, s in self.live_members()}
         want = list(range(k))
-        available = sorted(live)
-        need = codec.minimum_to_decode(want, available)
         chunks: dict[int, bytes] = {}
+        failed: set[int] = set()
+        enoent = 0
         size = None
-        waits = []
-        for j in sorted(need):
-            target = live[j]
-            if target == self.osd.id:
-                cid = f"{self.pgid[0]}.{self.pgid[1]}s{j}"
-                chunk = bytes(self.osd.store.read(cid, oid))
-                self._verify_hinfo(cid, oid, chunk)
-                chunks[j] = chunk
-                size = denc.dec_u64(
-                    self.osd.store.getattr(cid, oid, ATTR_SIZE), 0
-                )[0]
-                continue
-            subtid = self.osd.new_subtid()
-            fut = self.osd.expect_reply(subtid)
-            waits.append((j, target, subtid, fut))
-            await self.osd.send(
-                f"osd.{target}",
-                M.MECSubRead(tid=subtid, pgid=self.pgid, shard=j, oid=oid,
-                             offset=0, length=-1),
-            )
-        for j, target, subtid, fut in waits:
-            reply = await self.osd.await_reply(subtid, fut, target)
-            if reply.result != M.OK:
-                raise KeyError(oid)  # shard lost it -> ENOENT upward
-            chunks[j] = reply.data
-            if size is None:
-                size = reply.size
+        while True:
+            usable = [s for s in sorted(live) if s not in failed]
+            try:
+                need = codec.minimum_to_decode(want, usable)
+            except Exception:
+                # not enough healthy shards left
+                if enoent and not chunks:
+                    raise KeyError(oid)  # object genuinely absent
+                raise IOError(
+                    f"cannot reconstruct {oid!r}: shards {sorted(failed)} "
+                    f"unreadable"
+                )
+            waits = []
+            for j in sorted(need):
+                if j in chunks:
+                    continue
+                target = live[j]
+                if target == self.osd.id:
+                    cid = f"{self.pgid[0]}.{self.pgid[1]}s{j}"
+                    try:
+                        if self.osd.fault.hit("ec_local_read", oid=oid,
+                                              shard=j):
+                            raise IOError("injected local EIO")
+                        chunk = bytes(self.osd.store.read(cid, oid))
+                        self._verify_hinfo(cid, oid, chunk)
+                        chunks[j] = chunk
+                        size = denc.dec_u64(
+                            self.osd.store.getattr(cid, oid, ATTR_SIZE), 0
+                        )[0]
+                    except NotFound:
+                        enoent += 1
+                        failed.add(j)
+                    except IOError:
+                        failed.add(j)
+                    continue
+                subtid = self.osd.new_subtid()
+                fut = self.osd.expect_reply(subtid)
+                waits.append((j, target, subtid, fut))
+                await self.osd.send(
+                    f"osd.{target}",
+                    M.MECSubRead(tid=subtid, pgid=self.pgid, shard=j,
+                                 oid=oid, offset=0, length=-1),
+                )
+            for j, target, subtid, fut in waits:
+                reply = await self.osd.await_reply(subtid, fut, target)
+                if reply.result == M.OK:
+                    chunks[j] = reply.data
+                    if size is None:
+                        size = reply.size
+                else:
+                    if reply.result == M.ENOENT:
+                        enoent += 1
+                    failed.add(j)
+            if all(j in chunks for j in need):
+                break
         if size is None:
             raise KeyError(oid)
         decoded = codec.decode(want, chunks)
@@ -461,6 +492,9 @@ class PG:
 
     async def handle_ec_read(self, src: str, m: M.MECSubRead) -> None:
         try:
+            if self.osd.fault.hit("ec_sub_read", oid=m.oid,
+                                  osd=self.osd.id, shard=m.shard):
+                raise IOError("injected EIO")
             chunk = bytes(self.osd.store.read(self.cid, m.oid))
             self._verify_hinfo(self.cid, m.oid, chunk)
             digest = denc.dec_u32(
@@ -472,9 +506,15 @@ class PG:
             reply = M.MECSubReadReply(tid=m.tid, pgid=self.pgid,
                                       shard=m.shard, result=M.OK,
                                       data=chunk, digest=digest, size=size)
-        except Exception:
+        except (NotFound, KeyError):
             reply = M.MECSubReadReply(tid=m.tid, pgid=self.pgid,
                                       shard=m.shard, result=M.ENOENT,
+                                      data=b"", digest=0, size=0)
+        except Exception:
+            # EIO/corruption: distinct from "never had it" so the
+            # primary can count true absence (handle_sub_read's EIO arc)
+            reply = M.MECSubReadReply(tid=m.tid, pgid=self.pgid,
+                                      shard=m.shard, result=M.EIO,
                                       data=b"", digest=0, size=0)
         await self.osd.send(src, reply)
 
@@ -483,16 +523,18 @@ class PG:
     async def _peer_and_recover(self) -> None:
         """Run peering rounds until one completes under a stable epoch
         (a mid-round map change invalidates the round — the reference
-        restarts its PeeringMachine on AdvMap the same way)."""
-        try:
-            while self.is_primary() and self.state != "active":
+        restarts its PeeringMachine on AdvMap the same way). Transient
+        errors (peer vanished mid-round, send failure) retry the round;
+        only cancellation stops the loop."""
+        while self.is_primary() and self.state != "active":
+            try:
                 if await self._do_peering():
                     break
-                await asyncio.sleep(0.02)
-        except asyncio.CancelledError:
-            raise
-        except Exception:
-            self.osd.log_exc(f"pg {self.pgid} peering")
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                self.osd.log_exc(f"pg {self.pgid} peering")
+            await asyncio.sleep(0.02)
 
     async def _do_peering(self) -> bool:
         """GetInfo -> choose authoritative -> recover self -> recover
@@ -512,14 +554,24 @@ class PG:
                 f"osd.{o}",
                 M.MPGInfoReq(pgid=self.pgid, epoch=epoch, shard=s),
             )
+        complete = True
         for o, s, fut in waits:
             try:
                 reply = await asyncio.wait_for(fut, osd.subop_timeout)
             except asyncio.TimeoutError:
                 osd.drop_reply(("info", self.pgid, o, s))
-                continue  # peer died; map change will re-peer
+                # an UP member that won't answer blocks peering: going
+                # active without its info would skip its recovery. Either
+                # it answers on retry (boot race) or the mon marks it
+                # down and it leaves live_members (reference PGs stay in
+                # Peering/GetInfo until the prior set resolves the same
+                # way).
+                complete = False
+                continue
             info, _ = PGInfo.decode(reply.info)
             infos[(o, s)] = info
+        if not complete:
+            return False
 
         if osd.osdmap.epoch != epoch:
             return False  # superseded; caller retries under the new map
@@ -648,21 +700,42 @@ class PG:
 
     async def _reconstruct_chunk(self, oid: bytes, shard: int):
         """Rebuild shard `shard`'s chunk from k survivors (the recovery
-        read-reconstruct path, ECBackend continue_recovery_op role)."""
+        read-reconstruct path, ECBackend continue_recovery_op role).
+        Unreadable survivors (EIO, bit rot failing their hinfo) are
+        excluded and the fetch set re-planned, like _read_ec."""
         codec = self.osd.codec_for(self.pool)
         live = {s: o for o, s in self.live_members()}
-        available = [s for s in sorted(live) if s != shard]
-        need = codec.minimum_to_decode([shard], available)
         chunks: dict[int, bytes] = {}
+        failed: set[int] = {shard}
         size_attr = None
         remote_size = None
-        for j in sorted(need):
-            target = live[j]
-            cidj = f"{self.pgid[0]}.{self.pgid[1]}s{j}"
-            if target == self.osd.id:
-                chunks[j] = bytes(self.osd.store.read(cidj, oid))
-                size_attr = self.osd.store.getattr(cidj, oid, ATTR_SIZE)
-            else:
+        while True:
+            usable = [s for s in sorted(live) if s not in failed]
+            try:
+                need = codec.minimum_to_decode([shard], usable)
+            except Exception:
+                raise RuntimeError(
+                    f"cannot reconstruct shard {shard} of {oid!r}: "
+                    f"unreadable {sorted(failed - {shard})}"
+                )
+            progress = False
+            for j in sorted(need):
+                if j in chunks:
+                    continue
+                target = live[j]
+                cidj = f"{self.pgid[0]}.{self.pgid[1]}s{j}"
+                if target == self.osd.id:
+                    try:
+                        chunk = bytes(self.osd.store.read(cidj, oid))
+                        self._verify_hinfo(cidj, oid, chunk)
+                        chunks[j] = chunk
+                        size_attr = self.osd.store.getattr(
+                            cidj, oid, ATTR_SIZE
+                        )
+                        progress = True
+                    except Exception:
+                        failed.add(j)
+                    continue
                 subtid = self.osd.new_subtid()
                 fut = self.osd.expect_reply(subtid)
                 await self.osd.send(
@@ -671,10 +744,16 @@ class PG:
                                  oid=oid, offset=0, length=-1),
                 )
                 reply = await self.osd.await_reply(subtid, fut, target)
-                if reply.result != M.OK:
-                    raise RuntimeError(f"recovery read failed shard {j}")
-                chunks[j] = reply.data
-                remote_size = reply.size
+                if reply.result == M.OK:
+                    chunks[j] = reply.data
+                    remote_size = reply.size
+                    progress = True
+                else:
+                    failed.add(j)
+            if all(j in chunks for j in need):
+                break
+            if not progress:
+                continue  # re-plan with the enlarged failed set
         if size_attr is None:
             size_attr = denc.enc_u64(remote_size or 0)
         decoded = codec.decode([shard], chunks)
@@ -692,7 +771,7 @@ class PG:
         info = PGInfo(self.log.head, self.log)
         await self.osd.send(
             src,
-            M.MPGInfoReply(pgid=self.pgid, epoch=self.osd.osdmap.epoch,
+            M.MPGInfoReply(pgid=self.pgid, epoch=self.osd.epoch,
                            shard=m.shard, info=info.encode()),
         )
 
@@ -717,9 +796,147 @@ class PG:
         await self.osd.send(
             src,
             M.MPushOp(pgid=self.pgid, shard=m.shard, oid=m.oid, version=v,
-                      data=data, attrs=attrs, epoch=self.osd.osdmap.epoch,
+                      data=data, attrs=attrs, epoch=self.osd.epoch,
                       last_update=self.log.head),
         )
+
+    # ========================================================== scrub ==
+
+    def _local_scrub_map(self):
+        """ScrubMap of this PG instance: batched digests + versions;
+        EC shards self-verify chunk bytes against stored hinfo."""
+        from .scrub import digest_map
+
+        objects = {}
+        errors: list[bytes] = []
+        if self.cid not in self.osd.store.list_collections():
+            return objects, errors
+        digests = digest_map(self.osd.store, self.cid, skip=(META_OID,))
+        for oid, (size, crc) in digests.items():
+            objects[oid] = (self._object_version(oid), (size, crc))
+            if self.is_ec:
+                try:
+                    stored = denc.dec_u32(
+                        self.osd.store.getattr(self.cid, oid, ATTR_HINFO), 0
+                    )[0]
+                except Exception:
+                    stored = None
+                if stored is not None and stored != crc:
+                    errors.append(oid)
+        return objects, errors
+
+    async def handle_scrub(self, src: str, m: M.MScrub) -> None:
+        objects, errors = self._local_scrub_map()
+        await self.osd.send(
+            src,
+            M.MScrubReply(pgid=self.pgid, shard=m.shard, tid=m.tid,
+                          objects=objects, errors=errors),
+        )
+
+    async def scrub(self) -> dict:
+        """Primary-driven scrub round: gather ScrubMaps from every live
+        member, compare, repair divergent/corrupt copies via the
+        recovery push machinery. Returns a report (the scrubber's
+        inconsistent-objects output)."""
+        osd = self.osd
+        if not self.is_primary() or self.state != "active":
+            raise RuntimeError("scrub requires an active primary")
+        peers = [(o, s) for o, s in self.live_members() if o != osd.id]
+        maps: dict[tuple[int, int], dict] = {}
+        bad: dict[tuple[int, int], set[bytes]] = {}
+        objs, errs = self._local_scrub_map()
+        me = (osd.id, self.shard)
+        maps[me] = objs
+        bad[me] = set(errs)
+        waits = []
+        for o, s in peers:
+            subtid = osd.new_subtid()
+            fut = osd.expect_reply(subtid)
+            waits.append((o, s, subtid, fut))
+            await osd.send(
+                f"osd.{o}",
+                M.MScrub(pgid=self.pgid, shard=s, epoch=osd.epoch,
+                         tid=subtid),
+            )
+        for o, s, subtid, fut in waits:
+            reply = await osd.await_reply(subtid, fut, o)
+            maps[(o, s)] = reply.objects
+            bad[(o, s)] = set(reply.errors)
+
+        report = {"inconsistent": [], "repaired": [], "clean": 0}
+        all_oids = sorted({oid for m_ in maps.values() for oid in m_})
+        for oid in all_oids:
+            if self.is_ec:
+                ok = await self._scrub_repair_ec(oid, maps, bad)
+            else:
+                ok = await self._scrub_repair_replicated(oid, maps)
+            if ok is None:
+                report["clean"] += 1
+            else:
+                report["inconsistent"].append(oid)
+                report["repaired"].extend(ok)
+        return report
+
+    async def _scrub_repair_replicated(self, oid, maps):
+        """Compare whole-object digests across replicas; push the
+        authoritative copy over divergent/missing ones. Returns None if
+        clean, else the list of repaired member keys."""
+        from .scrub import pick_authoritative
+
+        copies = {key: m_[oid] for key, m_ in maps.items() if oid in m_}
+        auth_key, auth = pick_authoritative(copies)
+        divergent = [
+            key for key in maps
+            if maps[key].get(oid) != (auth[0], auth[1])
+        ]
+        if not divergent:
+            return None
+        me = (self.osd.id, self.shard)
+        if me in divergent:
+            # repair self first: pull from the authoritative holder
+            o, s = auth_key
+            fut = self.osd.expect_reply(("push", self.pgid, self.shard,
+                                         oid))
+            await self.osd.send(
+                f"osd.{o}",
+                M.MPull(pgid=self.pgid, shard=s, oid=oid,
+                        epoch=self.osd.epoch),
+            )
+            await asyncio.wait_for(fut, self.osd.subop_timeout)
+        for o, s in divergent:
+            if (o, s) == me:
+                continue
+            await self._push_object(
+                o, s, oid, Entry(OP_MODIFY, oid, auth[0])
+            )
+        return divergent
+
+    async def _scrub_repair_ec(self, oid, maps, bad):
+        """EC scrub: a member is divergent when its version lags, its
+        chunk fails its own hinfo (bit rot), or the chunk is missing;
+        repair = reconstruct that shard from survivors and push."""
+        copies = {key: m_[oid] for key, m_ in maps.items() if oid in m_}
+        newest = max(v for v, _ in copies.values())
+        divergent = []
+        for key, m_ in maps.items():
+            ent = m_.get(oid)
+            if ent is None or ent[0] != newest or oid in bad[key]:
+                divergent.append(key)
+        if not divergent:
+            return None
+        me = (self.osd.id, self.shard)
+        repaired = []
+        for o, s in divergent:
+            if (o, s) == me:
+                await self._recover_own_chunk(oid, newest)
+            else:
+                await self._push_object(
+                    o, s, oid, Entry(OP_MODIFY, oid, newest)
+                )
+            repaired.append((o, s))
+        return repaired
+
+    # ---------------------------------------------- peering-side handlers
 
     async def handle_push(self, src: str, m: M.MPushOp) -> None:
         """Receive a recovery push: install object + attrs, ack."""

@@ -76,6 +76,16 @@ class TestCluster:
                 await asyncio.sleep(0.02)
         await asyncio.wait_for(_wait(), timeout)
 
+    async def scrub_pg(self, pgid: tuple[int, int]) -> dict:
+        """Run a scrub round on pgid's primary (the `ceph pg scrub`
+        verb)."""
+        up, primary = self.mon.osdmap.pg_to_up_acting_osds(pgid)
+        osd = self.osds[primary]
+        assert osd is not None, f"primary osd.{primary} is down"
+        pg = osd._pg_for_primary(pgid)
+        assert pg is not None
+        return await pg.scrub()
+
     async def wait_active(self, timeout: float = 10.0) -> None:
         """Wait until every live OSD's PGs are active and map epochs have
         converged (the `ceph health` wait-for-clean role)."""

@@ -1,0 +1,61 @@
+"""FaultInjector: named failure sites armed by tests/config (the
+src/common/fault_injector.h:66 role, plus the config-driven error
+injection style of bluestore_debug_inject_read_err /
+ms_inject_socket_failures in src/common/options/global.yaml.in).
+
+A site is armed with an optional match filter and a trigger budget;
+production code calls ``hit(site, **attrs)`` at the failure point and
+raises/returns-error when it fires. Disarmed sites cost one dict lookup.
+"""
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+
+
+@dataclass
+class _Arm:
+    remaining: int  # triggers left; <0 = unlimited
+    match: dict = field(default_factory=dict)
+    fired: int = 0
+
+
+class FaultInjector:
+    def __init__(self) -> None:
+        self._arms: dict[str, list[_Arm]] = {}
+        self._lock = threading.Lock()
+
+    def arm(self, site: str, count: int = -1, **match) -> None:
+        """Arm `site` to fire `count` times (-1 = forever) when every
+        key in `match` equals the corresponding hit() attribute."""
+        with self._lock:
+            self._arms.setdefault(site, []).append(_Arm(count, match))
+
+    def disarm(self, site: str) -> None:
+        with self._lock:
+            self._arms.pop(site, None)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._arms.clear()
+
+    def hit(self, site: str, **attrs) -> bool:
+        """Called at the failure point; True = inject the failure."""
+        arms = self._arms.get(site)
+        if not arms:
+            return False
+        with self._lock:
+            for arm in arms:
+                if arm.remaining == 0:
+                    continue
+                if any(attrs.get(k) != v for k, v in arm.match.items()):
+                    continue
+                if arm.remaining > 0:
+                    arm.remaining -= 1
+                arm.fired += 1
+                return True
+        return False
+
+    def fired(self, site: str) -> int:
+        """Total times `site` actually injected (for test assertions)."""
+        return sum(a.fired for a in self._arms.get(site, []))
