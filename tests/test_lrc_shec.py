@@ -1,0 +1,165 @@
+"""LRC + SHEC plugin tests (the TestErasureCodeLrc/TestErasureCodeShec
+roles): round-trips under every erasure pattern the codes tolerate,
+locality of repair reads, shingle windows, and kml generation."""
+import itertools
+
+import numpy as np
+import pytest
+
+from ceph_tpu.ec import ECError, load_codec
+from ceph_tpu.ec.shec_plugin import _shec_matrix, _window
+
+RNG = np.random.default_rng(123)
+
+
+def roundtrip(codec, obj: bytes, erase: set[int]) -> None:
+    n = codec.get_chunk_count()
+    encoded = codec.encode(list(range(n)), obj)
+    assert set(encoded) == set(range(n))
+    avail = {i: encoded[i] for i in range(n) if i not in erase}
+    want = sorted(erase) or list(range(n))
+    need = codec.minimum_to_decode(want, sorted(avail))
+    assert set(need) <= set(avail), "plan demands an erased chunk"
+    decoded = codec.decode(want, {i: avail[i] for i in need})
+    for i in want:
+        np.testing.assert_array_equal(
+            decoded[i], encoded[i], err_msg=f"chunk {i}, erase {erase}"
+        )
+
+
+# ------------------------------------------------------------------ LRC
+
+
+def lrc_docs_codec():
+    return load_codec({
+        "plugin": "lrc",
+        "mapping": "__DD__DD",
+        "layers": '[["_cDD_cDD", ""], ["cDDD____", ""], ["____cDDD", ""]]',
+    })
+
+
+def test_lrc_docs_example_roundtrip():
+    codec = lrc_docs_codec()
+    assert codec.k == 4
+    assert codec.get_chunk_count() == 8
+    obj = RNG.integers(0, 256, 4096, dtype=np.uint8).tobytes()
+    for erase in ([set()] + [{i} for i in range(8)]
+                  + [{2, 7}, {0, 4}, {1, 5}, {3, 6}]):
+        roundtrip(codec, obj, erase)
+
+
+def test_lrc_local_repair_reads_fewer():
+    """Losing chunk 7 must be repairable from the last-four group (the
+    doc's 'loss of chunk 7 can be recovered with the last four
+    chunks')."""
+    codec = lrc_docs_codec()
+    need = codec.minimum_to_decode([7], [0, 1, 2, 3, 4, 5, 6])
+    assert set(need) <= {4, 5, 6}
+    need2 = codec.minimum_to_decode([2], [0, 1, 3, 4, 5, 6, 7])
+    assert set(need2) <= {0, 1, 3}
+
+
+def test_lrc_kml_generation():
+    codec = load_codec({"plugin": "lrc", "k": "4", "m": "2", "l": "3"})
+    # (k+m)/l = 2 groups: 4 data + 2 global + 2 local = 8 chunks
+    assert codec.k == 4
+    assert codec.get_chunk_count() == 8
+    assert codec.profile["mapping"] == "DD__DD__"
+    obj = RNG.integers(0, 256, 8192, dtype=np.uint8).tobytes()
+    for erase in [set(), {0}, {3}, {6}, {0, 4}, {2, 5}]:
+        roundtrip(codec, obj, erase)
+    # single data loss repairs within its group of l+1 chunks
+    need = codec.minimum_to_decode([0], list(range(1, 8)))
+    assert len(need) == 3
+    assert set(need) <= {1, 2, 3}  # group 0 = positions 0..3
+
+
+def test_lrc_kml_validation():
+    with pytest.raises(ECError):
+        load_codec({"plugin": "lrc", "k": "4", "m": "2", "l": "5"})
+    with pytest.raises(ECError):
+        load_codec({"plugin": "lrc", "k": "4", "m": "2"})
+    with pytest.raises(ECError):
+        load_codec({
+            "plugin": "lrc", "k": "2", "m": "1", "l": "3",
+            "mapping": "DD_",
+        })
+
+
+def test_lrc_unrecoverable():
+    codec = lrc_docs_codec()
+    # global layer has k=4: losing 5 chunks incl. all of one group's
+    # data beats every layer
+    with pytest.raises(ECError):
+        codec.minimum_to_decode([2], [0, 4, 5])
+
+
+def test_lrc_layered_chain_repair():
+    """A coding chunk consumed by a later layer (step 1's c at position
+    1 feeds step 2) must be reconstructible through multi-step plans."""
+    codec = lrc_docs_codec()
+    # erase chunk 0 (layer-2 coding) and chunk 2 (its input): repair
+    # needs chunk2 first (layer 1), then chunk 0 (layer 2)
+    obj = RNG.integers(0, 256, 2048, dtype=np.uint8).tobytes()
+    roundtrip(codec, obj, {0, 2})
+
+
+# ----------------------------------------------------------------- SHEC
+
+
+def test_shec_window_semantics():
+    # single group m=3, c=2, k=6: parity r covers [r*k/m, (r+c)*k/m)
+    assert _window(0, 6, 3, 2) == {0, 1, 2, 3}
+    assert _window(1, 6, 3, 2) == {2, 3, 4, 5}
+    assert _window(2, 6, 3, 2) == {4, 5, 0, 1}
+
+
+def test_shec_matrix_windows_zeroed():
+    mat = _shec_matrix(6, 3, 2, True)
+    for r in range(3):
+        cover = _window(r, 6, 3, 2)
+        for j in range(6):
+            if j in cover:
+                assert mat[r, j] != 0
+            else:
+                assert mat[r, j] == 0
+
+
+@pytest.mark.parametrize("technique", ["single", "multiple"])
+def test_shec_roundtrip_single_erasures(technique):
+    codec = load_codec({
+        "plugin": "shec", "k": "6", "m": "3", "c": "2",
+        "technique": technique,
+    })
+    obj = RNG.integers(0, 256, 6 * 512, dtype=np.uint8).tobytes()
+    for i in range(9):
+        roundtrip(codec, obj, {i})
+
+
+def test_shec_roundtrip_c_erasures():
+    """c=2 guarantees any 2 losses are recoverable."""
+    codec = load_codec({"plugin": "shec", "k": "4", "m": "3", "c": "2"})
+    obj = RNG.integers(0, 256, 4096, dtype=np.uint8).tobytes()
+    for erase in itertools.combinations(range(7), 2):
+        roundtrip(codec, obj, set(erase))
+
+
+def test_shec_local_repair_reads_fewer_than_k():
+    """The point of shingling: one lost data chunk reads < k+1 chunks
+    (a covering parity + its window, minus the lost chunk)."""
+    codec = load_codec({
+        "plugin": "shec", "k": "6", "m": "3", "c": "2",
+        "technique": "single",
+    })
+    need = codec.minimum_to_decode([0], [1, 2, 3, 4, 5, 6, 7, 8])
+    # parity 0 covers {0,1,2,3}: read parity 6 + data {1,2,3} = 4 reads
+    assert len(need) <= 4
+    assert 0 not in need
+
+
+def test_shec_defaults():
+    codec = load_codec({"plugin": "shec"})
+    assert (codec.k, codec.m, codec.c) == (4, 3, 2)
+    obj = b"shec-default" * 300
+    roundtrip(codec, obj, {1})
+    roundtrip(codec, obj, {5})
