@@ -1,0 +1,453 @@
+"""CLAY plugin: coupled-layer MSR regenerating code (the clay role,
+src/erasure-code/clay/ErasureCodeClay.cc semantics; construction from
+the public Clay-codes paper, FAST'18).
+
+Parameters (k, m, d): d helpers repair a single lost chunk reading only
+1/q of each helper (q = d-k+1) — repair bandwidth d/q sub-chunks vs the
+k full chunks an MDS code needs. Internally the k+m (+nu virtual
+shortening) chunks sit on a q×t grid of nodes (node = y*q + x,
+t = (k+m+nu)/q); each chunk splits into q^t sub-chunks, one per
+"plane" z (base-q digit vector z_vec, z_vec[0] most significant).
+
+Structure:
+- Uncoupled layer U: per plane z, the q*t node values form one MDS
+  codeword (scalar RS with k+nu data, m parity) — decode_uncoupled.
+- Coupling: node (x,y) in plane z pairs with node (z_vec[y], y) in the
+  companion plane z_sw (digit y swapped to x). The pair's coupled
+  values (C, C') and uncoupled values (U, U') form a tiny k=2,m=2 RS
+  codeword [C_first, C_second, U_first, U_second] (first = lower x),
+  so any two determine the others — the pairwise transform (PFT).
+  Vertices with x == z_vec[y] ("dots") are unpaired: C == U.
+- decode_layered recovers erasures plane by plane in increasing
+  intersection score (number of erased dots in the plane), converting
+  C→U for known nodes, MDS-decoding U for erased ones, then U→C.
+- Single-chunk repair reads only the q^(t-1) planes with
+  z_vec[y_lost] == x_lost from each of d helpers
+  (get_repair_subchunks runs) and rebuilds the lost chunk's other
+  planes through the pair partners in its own row.
+
+TPU stance: every per-plane MDS decode with the same erasure pattern is
+the same GF(2^8) matmul — planes of equal intersection score batch into
+one (planes, nodes, sc) kernel dispatch; the host path below is the
+bit-exactness oracle the device path is gated on.
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from ..ops import gf8
+from . import ECError, ErasureCode, _as_u8
+from .registry import load_codec, register
+
+
+@functools.lru_cache(maxsize=32)
+def _pft_matrix() -> np.ndarray:
+    """4x2 over GF(2^8): chunk_i = M[i] @ [A, B] for the pair code
+    [C_first, C_second, U_first, U_second] (k=2, m=2 reed_sol_van)."""
+    gen = gf8.vandermonde_rs_matrix(2, 2)
+    return np.vstack([np.eye(2, dtype=np.uint8), gen])
+
+
+def _pft_solve(known: dict[int, np.ndarray], want: list[int]) -> dict[int, np.ndarray]:
+    """Solve the pair code: any 2 known chunk roles -> wanted roles."""
+    m4 = _pft_matrix()
+    rows = sorted(known)[:2]
+    sub = m4[rows]
+    inv = gf8.gf_mat_inv(sub)
+    ab = gf8.gf_matmul(inv, np.stack([known[r] for r in rows]))
+    return {w: gf8.gf_matmul(m4[w][None], ab)[0] for w in want}
+
+
+class CLAYCodec(ErasureCode):
+    DEFAULT_K = 4
+    DEFAULT_M = 2
+
+    def init(self, profile) -> None:
+        super().init(profile)
+        self.k = self.to_int("k", self.DEFAULT_K)
+        self.m = self.to_int("m", self.DEFAULT_M)
+        self.d = self.to_int("d", self.k + self.m - 1)
+        if self.k < 2 or self.m < 1:
+            raise ECError(f"bad clay k={self.k} m={self.m}")
+        if not (self.k <= self.d <= self.k + self.m - 1):
+            raise ECError(
+                f"d={self.d} must be in [k={self.k}, k+m-1="
+                f"{self.k + self.m - 1}]"
+            )
+        self.q = self.d - self.k + 1
+        km = self.k + self.m
+        self.nu = (self.q - km % self.q) % self.q
+        if km + self.nu > 254:
+            raise ECError("k+m+nu must be <= 254")
+        self.t = (km + self.nu) // self.q
+        self.sub_chunk_no = self.q ** self.t
+        scalar = self.profile.get("scalar_mds", "rs_tpu")
+        if scalar == "jerasure":
+            scalar = "rs_tpu"
+        technique = self.profile.get("technique", "reed_sol_van")
+        self.mds = load_codec({
+            "plugin": scalar, "technique": technique,
+            "k": str(self.k + self.nu), "m": str(self.m),
+            "backend": "host",
+        })
+        self._parse_mapping()
+
+    # ------------------------------------------------------------ layout
+
+    def get_sub_chunk_count(self) -> int:
+        return self.sub_chunk_no
+
+    def get_alignment(self) -> int:
+        # every sub-chunk must stay word-aligned: chunk splits into
+        # q^t sub-chunks (get_chunk_size role, ErasureCodeClay.cc:90)
+        return self.sub_chunk_no * self.k * 4
+
+    def _node(self, chunk: int) -> int:
+        """Chunk index (0..k+m) -> grid node id (virtual nu inserted
+        between data and parity)."""
+        return chunk if chunk < self.k else chunk + self.nu
+
+    def _chunk(self, node: int) -> int | None:
+        if node < self.k:
+            return node
+        if node < self.k + self.nu:
+            return None  # virtual shortening node
+        return node - self.nu
+
+    def _z_vec(self, z: int) -> list[int]:
+        out = [0] * self.t
+        for i in range(self.t):
+            out[self.t - 1 - i] = z % self.q
+            z //= self.q
+        return out
+
+    def _z_sw(self, z: int, y: int, new_digit: int, old_digit: int) -> int:
+        return z + (new_digit - old_digit) * self.q ** (self.t - 1 - y)
+
+    # ------------------------------------------------------ pairwise ops
+
+    def _pair(self, x: int, y: int, z: int, z_vec: list[int]):
+        """Canonical pair for vertex (x, y, z): returns
+        ((node_first, z_first), (node_second, z_second)) ordered by x;
+        None for unpaired dots (x == z_vec[y])."""
+        x2 = z_vec[y]
+        if x2 == x:
+            return None
+        z_sw = self._z_sw(z, y, x, x2)
+        a = (y * self.q + x, z)
+        b = (y * self.q + x2, z_sw)
+        return (a, b) if x < x2 else (b, a)
+
+    # ------------------------------------------------------ encode path
+
+    def encode_chunks(self, data_chunks: np.ndarray) -> np.ndarray:
+        data_chunks = np.ascontiguousarray(data_chunks, dtype=np.uint8)
+        L = data_chunks.shape[1]
+        C = self._grid(L)
+        for i in range(self.k):
+            C[i] = self._split(data_chunks[i])
+        erased = {self._node(self.k + j) for j in range(self.m)}
+        self._decode_layered(erased, C, L)
+        return np.stack([
+            self._join(C[self._node(self.k + j)]) for j in range(self.m)
+        ])
+
+    def decode_chunks(self, present, chunks: np.ndarray):
+        present = list(present)
+        chunks = np.ascontiguousarray(chunks, dtype=np.uint8)
+        L = chunks.shape[1]
+        C = self._grid(L)
+        for row, idx in enumerate(present):
+            C[self._node(idx)] = self._split(chunks[row])
+        erased = {
+            self._node(i) for i in range(self.k + self.m)
+            if i not in present
+        }
+        self._decode_layered(erased, C, L)
+        return {
+            i: self._join(C[self._node(i)])
+            for i in range(self.k + self.m)
+        }
+
+    def _grid(self, L: int) -> np.ndarray:
+        if L % self.sub_chunk_no:
+            raise ECError(
+                f"chunk length {L} not a multiple of sub_chunk_count "
+                f"{self.sub_chunk_no}"
+            )
+        return np.zeros(
+            (self.q * self.t, self.sub_chunk_no, L // self.sub_chunk_no),
+            dtype=np.uint8,
+        )
+
+    def _split(self, chunk: np.ndarray) -> np.ndarray:
+        return chunk.reshape(self.sub_chunk_no, -1)
+
+    @staticmethod
+    def _join(grid_row: np.ndarray) -> np.ndarray:
+        return grid_row.reshape(-1)
+
+    # --------------------------------------------------- layered decode
+
+    def _decode_layered(self, erased: set[int], C: np.ndarray,
+                        L: int) -> None:
+        """decode_layered role: recover C rows for `erased` nodes (grid
+        node ids) in place. U is materialized alongside."""
+        q, t = self.q, self.t
+        erased = set(erased)
+        # pad erasures to exactly m with parity nodes (recomputable)
+        for i in range(self.k + self.nu, q * t):
+            if len(erased) >= self.m:
+                break
+            erased.add(i)
+        if len(erased) > self.m:
+            raise ECError(
+                f"{len(erased)} erasures exceed m={self.m}"
+            )
+        U = np.zeros_like(C)
+        order = self._plane_order(erased)
+        for iscore in range(t + 1):
+            planes = [z for z in range(self.sub_chunk_no)
+                      if order[z] == iscore]
+            # two passes per score round (the reference's two z-loops):
+            # every plane of the round completes its MDS before any
+            # U->C recovery runs, because a double-erased pair's
+            # conversion needs the companion plane's MDS output from
+            # the SAME round
+            for z in planes:
+                self._plane_c_to_u(erased, z, C, U)
+                self._plane_mds(erased, z, U)
+            for z in planes:
+                self._plane_u_to_c(erased, z, C, U)
+
+    def _plane_order(self, erased: set[int]) -> list[int]:
+        order = []
+        for z in range(self.sub_chunk_no):
+            zv = self._z_vec(z)
+            order.append(
+                sum(1 for i in erased if i % self.q == zv[i // self.q])
+            )
+        return order
+
+    def _plane_c_to_u(self, erased, z, C, U) -> None:
+        """decode_erasures' first half: U for every non-erased node of
+        plane z from coupled values (companion C recovered in an
+        earlier, lower-score plane when its node is erased)."""
+        zv = self._z_vec(z)
+        for y in range(self.t):
+            for x in range(self.q):
+                node = y * self.q + x
+                if node in erased:
+                    continue
+                pair = self._pair(x, y, z, zv)
+                if pair is None:  # dot: C == U
+                    U[node, z] = C[node, z]
+                    continue
+                me = 0 if pair[0] == (node, z) else 1
+                known = {me: C[node, z],
+                         1 - me: C[pair[1 - me][0], pair[1 - me][1]]}
+                U[node, z] = _pft_solve(known, [2 + me])[2 + me]
+
+    def _plane_mds(self, erased, z, U) -> None:
+        """decode_uncoupled: per-plane scalar MDS decode of U."""
+        present_nodes = [i for i in range(self.q * self.t)
+                         if i not in erased]
+        # mds generator index: node order = grid order (data+virtual
+        # first, then parity) — identical index spaces by construction
+        stack = np.stack([U[i, z] for i in present_nodes])
+        out = self.mds.decode_chunks(present_nodes, stack)
+        for i in erased:
+            U[i, z] = out[i]
+
+    def _plane_u_to_c(self, erased, z, C, U) -> None:
+        """decode_layered's recovery loop: C for erased nodes of plane
+        z (dots copy, type-1 solves with the known companion C, double
+        erasures convert both from U)."""
+        zv = self._z_vec(z)
+        for node in erased:
+            x, y = node % self.q, node // self.q
+            pair = self._pair(x, y, z, zv)
+            if pair is None:
+                C[node, z] = U[node, z]
+                continue
+            node_sw = y * self.q + zv[y]
+            z_sw = self._z_sw(z, y, x, zv[y])
+            me = 0 if pair[0] == (node, z) else 1
+            if node_sw not in erased:
+                known = {2 + me: U[node, z],
+                         1 - me: C[node_sw, z_sw]}
+                C[node, z] = _pft_solve(known, [me])[me]
+            elif zv[y] < x:
+                # both pair members erased: both U known; convert once
+                known = {2: U[pair[0][0], pair[0][1]],
+                         3: U[pair[1][0], pair[1][1]]}
+                out = _pft_solve(known, [0, 1])
+                C[pair[0][0], pair[0][1]] = out[0]
+                C[pair[1][0], pair[1][1]] = out[1]
+
+    # ---------------------------------------------------------- repair
+
+    def is_repair(self, want_to_read, available) -> bool:
+        """Repair path applies for a single loss when the lost node's
+        whole x-row survives and >= d chunks are available
+        (ErasureCodeClay::is_repair)."""
+        want = set(want_to_read)
+        avail = set(available)
+        if want <= avail or len(want) != 1:
+            return False
+        lost = next(iter(want))
+        node = self._node(lost)
+        y = node // self.q
+        for x in range(self.q):
+            other = y * self.q + x
+            chunk = self._chunk(other)
+            if chunk is None or chunk == lost:
+                continue
+            if chunk not in avail:
+                return False
+        return len(avail) >= self.d
+
+    def get_repair_subchunks(self, lost_chunk: int) -> list[tuple[int, int]]:
+        """(offset, count) runs of the repair planes — z with
+        z_vec[y_lost] == x_lost (get_repair_subchunks role)."""
+        node = self._node(lost_chunk)
+        y, x = node // self.q, node % self.q
+        seq = self.q ** (self.t - 1 - y)
+        runs = []
+        index = x * seq
+        for _ in range(self.q ** y):
+            runs.append((index, seq))
+            index += self.q * seq
+        return runs
+
+    def minimum_to_decode(self, want_to_read, available):
+        want = set(want_to_read)
+        avail = set(available)
+        if want <= avail:
+            return {c: [(0, self.sub_chunk_no)] for c in sorted(want)}
+        if self.is_repair(want, avail):
+            lost = next(iter(want))
+            runs = self.get_repair_subchunks(lost)
+            node = self._node(lost)
+            y = node // self.q
+            chosen: list[int] = []
+            for x in range(self.q):  # same-row nodes are mandatory
+                chunk = self._chunk(y * self.q + x)
+                if chunk is not None and chunk != lost:
+                    chosen.append(chunk)
+            for c in sorted(avail):
+                if len(chosen) >= self.d:
+                    break
+                if c not in chosen:
+                    chosen.append(c)
+            return {c: list(runs) for c in sorted(chosen[: self.d])}
+        return super().minimum_to_decode(want, avail)
+
+    def decode(self, want_to_read, chunks, chunk_size: int | None = None):
+        """Full decode, or the bandwidth-optimal repair path when the
+        caller passed repair-plane slices (detected via chunk_size like
+        the reference's decode(…, chunk_size))."""
+        want = set(want_to_read)
+        first = next(iter(chunks.values()), None)
+        if (chunk_size is not None and first is not None
+                and len(_as_u8(first)) < chunk_size
+                and self.is_repair(want, set(chunks))):
+            return self.repair(want, chunks)
+        return super().decode(want, chunks)
+
+    def repair(self, want_to_read, chunks):
+        """Rebuild one lost chunk from d helpers' repair-plane slices
+        (repair_one_lost_chunk role)."""
+        want = set(want_to_read)
+        if len(want) != 1 or len(chunks) < self.d:
+            raise ECError("repair needs exactly 1 want and d helpers")
+        lost = next(iter(want))
+        lost_node = self._node(lost)
+        q, t = self.q, self.t
+        y0, x0 = lost_node // q, lost_node % q
+        repair_planes = [
+            z for z in range(self.sub_chunk_no)
+            if self._z_vec(z)[y0] == x0
+        ]
+        plane_row = {z: i for i, z in enumerate(repair_planes)}
+        n_rep = len(repair_planes)
+        helpers: dict[int, np.ndarray] = {}
+        sc = None
+        for c, buf in chunks.items():
+            arr = _as_u8(buf)
+            if arr.size % n_rep:
+                raise ECError("helper slice not a repair-plane multiple")
+            helpers[self._node(c)] = arr.reshape(n_rep, -1)
+            sc = arr.size // n_rep
+        for v in range(self.k, self.k + self.nu):
+            helpers[v] = np.zeros((n_rep, sc), dtype=np.uint8)
+        aloof = {
+            self._node(c) for c in range(self.k + self.m)
+            if c != lost and self._node(c) not in helpers
+        }
+        erased = {y0 * q + x for x in range(q)} | aloof
+        # lost row (q nodes) + aloof (k+m-1-d) = m exactly when d
+        # helpers answered — the MDS per plane tolerates no more
+        if len(erased) > self.m:
+            raise ECError("too many erasures for repair")
+        U = np.zeros((q * t, self.sub_chunk_no, sc), dtype=np.uint8)
+        C_lost = np.zeros((self.sub_chunk_no, sc), dtype=np.uint8)
+        # plane order: intersection score over {lost row? no — lost +
+        # aloof dots} (reference counts recovered_data + aloof)
+        def score(z):
+            zv = self._z_vec(z)
+            s = sum(1 for n in aloof if n % q == zv[n // q])
+            if zv[y0] == x0:
+                s += 1
+            return s
+
+        for z in sorted(repair_planes, key=score):
+            zv = self._z_vec(z)
+            # U at every helper/virtual node of this plane
+            for y in range(t):
+                for x in range(q):
+                    node = y * q + x
+                    if node in erased:
+                        continue
+                    pair = self._pair(x, y, z, zv)
+                    if pair is None:
+                        U[node, z] = helpers[node][plane_row[z]]
+                        continue
+                    node_sw = y * q + zv[y]
+                    z_sw = self._z_sw(z, y, x, zv[y])
+                    me = 0 if pair[0] == (node, z) else 1
+                    if node_sw in aloof:
+                        known = {me: helpers[node][plane_row[z]],
+                                 3 - me: U[node_sw, z_sw]}
+                    else:
+                        known = {me: helpers[node][plane_row[z]],
+                                 1 - me: helpers[node_sw][plane_row[z_sw]]}
+                    U[node, z] = _pft_solve(known, [2 + me])[2 + me]
+            # per-plane MDS for erased nodes
+            present_nodes = [i for i in range(q * t) if i not in erased]
+            stack = np.stack([U[i, z] for i in present_nodes])
+            out = self.mds.decode_chunks(present_nodes, stack)
+            for i in erased:
+                U[i, z] = out[i]
+            # recover lost C: directly on repair planes, via row pair
+            # partners on companion planes
+            for node in erased:
+                if node in aloof:
+                    continue
+                x, y = node % q, node // q
+                if zv[y] == x:  # the lost node itself (dot here)
+                    C_lost[z] = U[node, z]
+                    continue
+                # row companion: node_sw is the lost node
+                z_sw = self._z_sw(z, y, x, zv[y])
+                pair = self._pair(x, y, z, zv)
+                me = 0 if pair[0] == (node, z) else 1
+                known = {me: helpers[node][plane_row[z]],
+                         2 + me: U[node, z]}
+                C_lost[z_sw] = _pft_solve(known, [1 - me])[1 - me]
+        return {lost: C_lost.reshape(-1)}
+
+
+register("clay", CLAYCodec)

@@ -98,14 +98,21 @@ class TcpMessenger:
         return addr
 
     async def close(self) -> None:
+        # order matters on py3.12+: Server.wait_closed() waits for the
+        # active connection handlers, so readers must be cancelled and
+        # drained FIRST or close deadlocks on any open connection
         if self._server:
             self._server.close()
-            await self._server.wait_closed()
         for w in self._conns.values():
             w.close()
         self._conns.clear()
-        for t in self._readers:
+        readers = list(self._readers)
+        for t in readers:
             t.cancel()
+        if readers:
+            await asyncio.gather(*readers, return_exceptions=True)
+        if self._server:
+            await self._server.wait_closed()
 
     async def _accept(self, reader, writer) -> None:
         task = asyncio.current_task()
