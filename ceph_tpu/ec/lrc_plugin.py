@@ -107,6 +107,8 @@ class LRCCodec(ErasureCode):
             inner_profile.setdefault("plugin", "rs_tpu")
             if inner_profile["plugin"] == "jerasure":
                 inner_profile["plugin"] = "rs_tpu"
+            if "backend" in self.profile:
+                inner_profile.setdefault("backend", self.profile["backend"])
             inner_profile["k"] = str(len(d))
             inner_profile["m"] = str(len(c))
             from .registry import load_codec

@@ -1,0 +1,64 @@
+"""Golden-bytes non-regression: every codec must reproduce the pinned
+corpus encodings exactly (ceph_erasure_code_non_regression.cc +
+ceph-erasure-code-corpus role). A failure here means the wire/disk
+format changed — that is NEVER a test to update casually; stored data
+depends on it."""
+import hashlib
+import json
+import os
+
+import numpy as np
+import pytest
+
+from ceph_tpu.ec import load_codec
+
+CORPUS_PATH = os.path.join(os.path.dirname(__file__), "corpus",
+                           "ec_corpus.json")
+
+with open(CORPUS_PATH) as f:
+    CORPUS = json.load(f)
+
+
+def payload(size: int) -> bytes:
+    return np.random.default_rng(0xEC0DE + size).integers(
+        0, 256, size, dtype=np.uint8
+    ).tobytes()
+
+
+def check_entry(entry: dict, profile: dict) -> None:
+    codec = load_codec(profile)
+    n = entry["n"]
+    assert codec.get_chunk_count() == n
+    for size_s, pinned in entry["sizes"].items():
+        size = int(size_s)
+        assert codec.get_chunk_size(size) == pinned["chunk_size"], (
+            f"chunk_size drift at object size {size}"
+        )
+        encoded = codec.encode(list(range(n)), payload(size))
+        got = [
+            hashlib.sha256(encoded[i].tobytes()).hexdigest()[:24]
+            for i in range(n)
+        ]
+        assert got == pinned["chunks"], (
+            f"ENCODING DRIFT: profile={profile} size={size}"
+        )
+
+
+@pytest.mark.parametrize("key", sorted(CORPUS))
+def test_corpus_host(key):
+    entry = CORPUS[key]
+    check_entry(entry, dict(entry["profile"]))
+
+
+@pytest.mark.parametrize(
+    "key",
+    [k for k in sorted(CORPUS)
+     if CORPUS[k]["profile"].get("plugin") == "rs_tpu"],
+)
+def test_corpus_device_backend(key):
+    """The batched device kernels must match the host corpus bytes —
+    the bit-exactness gate for every kernel change."""
+    entry = CORPUS[key]
+    profile = dict(entry["profile"])
+    profile["backend"] = "device"
+    check_entry(entry, profile)

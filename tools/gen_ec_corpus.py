@@ -1,0 +1,105 @@
+#!/usr/bin/env python3
+"""Generate the EC golden-bytes corpus (the ceph-erasure-code-corpus +
+ceph_erasure_code_non_regression role, src/test/erasure-code/
+ceph_erasure_code_non_regression.cc).
+
+For every (plugin, technique/config, k, m, object size) in the matrix,
+encode a deterministic seeded payload with the HOST reference path and
+pin the SHA-256 of every chunk. tests/test_corpus.py re-encodes with
+both host and device backends and fails on any byte drift — encodings
+are an on-disk format: once written, future kernels must reproduce
+them forever.
+
+Run: python tools/gen_ec_corpus.py [--check]
+Corpus lives at tests/corpus/ec_corpus.json (checked in).
+"""
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from ceph_tpu.ec import load_codec  # noqa: E402
+
+SIZES = (31, 4096, 65537)
+
+MATRIX: list[dict] = []
+for technique in ("reed_sol_van", "reed_sol_r6_op", "cauchy_orig",
+                  "cauchy_good"):
+    for k, m in ((2, 1), (4, 2), (8, 3), (8, 4)):
+        if technique == "reed_sol_r6_op" and m != 2:
+            continue
+        MATRIX.append({
+            "plugin": "rs_tpu", "technique": technique,
+            "k": str(k), "m": str(m), "backend": "host",
+        })
+MATRIX += [
+    {"plugin": "lrc", "mapping": "__DD__DD",
+     "layers": '[["_cDD_cDD", ""], ["cDDD____", ""], ["____cDDD", ""]]'},
+    {"plugin": "lrc", "k": "4", "m": "2", "l": "3"},
+    {"plugin": "shec", "k": "4", "m": "3", "c": "2"},
+    {"plugin": "shec", "k": "6", "m": "3", "c": "2",
+     "technique": "single"},
+    {"plugin": "clay", "k": "4", "m": "2"},
+    {"plugin": "clay", "k": "3", "m": "2", "d": "4"},
+    {"plugin": "clay", "k": "4", "m": "3"},
+]
+
+
+def profile_key(profile: dict) -> str:
+    return "&".join(f"{k}={v}" for k, v in sorted(profile.items()))
+
+
+def payload(size: int) -> bytes:
+    return np.random.default_rng(0xEC0DE + size).integers(
+        0, 256, size, dtype=np.uint8
+    ).tobytes()
+
+
+def encode_entry(profile: dict) -> dict:
+    codec = load_codec(dict(profile))
+    n = codec.get_chunk_count()
+    sizes = {}
+    for size in SIZES:
+        encoded = codec.encode(list(range(n)), payload(size))
+        sizes[str(size)] = {
+            "chunk_size": codec.get_chunk_size(size),
+            "chunks": [
+                hashlib.sha256(encoded[i].tobytes()).hexdigest()[:24]
+                for i in range(n)
+            ],
+        }
+    return {"profile": profile, "n": n, "sizes": sizes}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--check", action="store_true",
+                    help="verify against the existing corpus, no write")
+    args = ap.parse_args()
+    path = os.path.join(os.path.dirname(__file__), "..", "tests",
+                        "corpus", "ec_corpus.json")
+    corpus = {profile_key(p): encode_entry(p) for p in MATRIX}
+    if args.check:
+        with open(path) as f:
+            want = json.load(f)
+        if want != corpus:
+            print("CORPUS DRIFT DETECTED", file=sys.stderr)
+            return 1
+        print(f"corpus clean: {len(corpus)} configs x {len(SIZES)} sizes")
+        return 0
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(corpus, f, indent=1, sort_keys=True)
+    print(f"wrote {len(corpus)} configs to {path}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
