@@ -23,7 +23,10 @@ import numpy as np
 from ..ec import load_codec
 from ..placement import encoding as menc
 from ..store.memstore import MemStore
+from ..utils import config as cfg
+from ..utils.admin import AdminSocket
 from ..utils.fault import FaultInjector
+from ..utils.perf import PerfCounters
 from . import messages as M
 from .pg import NONE, PG
 
@@ -34,9 +37,10 @@ class ECBatcher:
     """Collects EC stripes for one reactor tick, encodes them as one
     device batch per (codec profile, chunk words) bucket."""
 
-    def __init__(self) -> None:
+    def __init__(self, perf=None) -> None:
         self._pending: dict[tuple, list] = {}
         self._flushing = False
+        self.perf = perf
 
     async def encode(self, codec, data: bytes) -> dict[int, np.ndarray]:
         """-> {chunk_index: uint8 chunk} for one stripe; batches with
@@ -69,6 +73,9 @@ class ECBatcher:
         for (_cid, _bs), items in pending.items():
             codec = items[0][0]
             batch = np.stack([stripe for _, stripe, _ in items])
+            if self.perf is not None:
+                self.perf.inc("ec_batches")
+                self.perf.observe("ec_batch_stripes", len(items))
             try:
                 parity = np.asarray(codec.encode_batch(batch))
             except Exception:
@@ -88,27 +95,56 @@ class OSDLite:
         bus,
         osd_id: int,
         store=None,
-        hb_interval: float = 0.25,
-        subop_timeout: float = 3.0,
-        log_keep: int = 128,
+        hb_interval: float | None = None,
+        subop_timeout: float | None = None,
+        log_keep: int | None = None,
+        conf: cfg.ConfigProxy | None = None,
     ):
         self.bus = bus
         self.id = osd_id
         self.name = f"osd.{osd_id}"
+        self.conf = conf if conf is not None else cfg.proxy()
         self.store = store if store is not None else MemStore()
         self.osdmap = None
         self.pgs: dict[tuple[int, int, int], PG] = {}  # (pool, ps, shard)
-        self.hb_interval = hb_interval
-        self.subop_timeout = subop_timeout
-        self.log_keep = log_keep
-        self.ec_batcher = ECBatcher()
+        # explicit args win over config (tests pass them directly); the
+        # config path is what a deployed daemon uses
+        self.hb_interval = (hb_interval if hb_interval is not None
+                            else self.conf["osd_heartbeat_interval"])
+        self.subop_timeout = (subop_timeout if subop_timeout is not None
+                              else self.conf["osd_subop_timeout"])
+        self.log_keep = (log_keep if log_keep is not None
+                         else self.conf["osd_pg_log_keep"])
+        self.conf.observe("osd_heartbeat_interval",
+                          lambda _n, v: setattr(self, "hb_interval", v))
+        self.conf.observe("osd_subop_timeout",
+                          lambda _n, v: setattr(self, "subop_timeout", v))
         self.fault = FaultInjector()
+        self.perf = PerfCounters(self.name)
+        self._declare_counters()
+        self.ec_batcher = ECBatcher(self.perf)
+        self.admin: AdminSocket | None = None
         self.pending: dict = {}  # key -> Future (sub-op replies)
         self._subtid = 0
         self._codecs: dict[int, object] = {}
         self._hb_task: asyncio.Task | None = None
         self._tasks: set[asyncio.Task] = set()
         self.stopped = False
+
+    def _declare_counters(self) -> None:
+        """The l_osd_* counter set (src/osd/osd_perf_counters.cc role,
+        trimmed to what the lite daemon does)."""
+        p = self.perf
+        p.add_u64_counter("op", "client ops dispatched")
+        p.add_u64_counter("op_r", "client reads")
+        p.add_u64_counter("op_w", "client writes")
+        p.add_time_avg("op_latency", "client op latency")
+        p.add_u64_counter("subop_w", "replica/shard sub-writes applied")
+        p.add_u64_counter("ec_batches", "batched EC device dispatches")
+        p.add_histogram("ec_batch_stripes", "stripes per EC batch")
+        p.add_u64_counter("recovery_pushes", "objects pushed to peers")
+        p.add_u64_counter("scrubs", "scrub rounds executed")
+        p.add_u64_counter("map_epochs", "osdmap epochs consumed")
 
     # ----------------------------------------------------------- plumbing
 
@@ -200,9 +236,43 @@ class OSDLite:
             self._hb_loop()
         )
 
+    async def start_admin(self, path: str) -> None:
+        """Expose the daemon on an admin socket (`ceph daemon` role)."""
+        sock = AdminSocket(path)
+        sock.register("perf dump", lambda a: self.perf.dump(),
+                      "runtime counters")
+        sock.register("config show", lambda a: self.conf.show(),
+                      "effective configuration")
+        sock.register(
+            "config set",
+            lambda a: (self.conf.set(a["key"], a["value"]), "ok")[1],
+            "set a runtime option: {key, value}",
+        )
+        sock.register(
+            "dump_pgs",
+            lambda a: {
+                pg.cid: {"state": pg.state, "acting": pg.acting,
+                         "primary": pg.primary,
+                         "log_head": list(pg.log.head)}
+                for pg in self.pgs.values()
+            },
+            "per-PG state",
+        )
+        sock.register(
+            "status",
+            lambda a: {"osd": self.id, "epoch": self.epoch,
+                       "pgs": len(self.pgs), "stopped": self.stopped},
+            "daemon status",
+        )
+        await sock.start()
+        self.admin = sock
+
     async def stop(self) -> None:
         """Crash-stop: no goodbyes (kill_osd role, ceph_manager.py:336)."""
         self.stopped = True
+        if self.admin is not None:
+            await self.admin.stop()
+            self.admin = None
         if self._hb_task:
             self._hb_task.cancel()
         for t in list(self._tasks):
@@ -350,6 +420,7 @@ class OSDLite:
                 )
                 return
             self.osdmap.apply_incremental(inc)
+            self.perf.inc("map_epochs")
         if not self.osdmap.osds[self.id].up:
             # wrongly marked down while alive: re-assert ourselves (the
             # reference OSD restarts its boot sequence on seeing itself

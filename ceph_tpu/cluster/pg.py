@@ -26,6 +26,7 @@ pglog.py for the consequences for peering.
 from __future__ import annotations
 
 import asyncio
+import time
 from typing import TYPE_CHECKING
 
 import numpy as np
@@ -179,6 +180,10 @@ class PG:
         if self.state != "active":
             self.waiting.append((src, m))
             return
+        perf = self.osd.perf
+        perf.inc("op")
+        perf.inc("op_w" if m.op in ("writefull", "delete") else "op_r")
+        t0 = time.perf_counter()
         try:
             if m.op == "writefull":
                 async with self.lock:
@@ -212,6 +217,7 @@ class PG:
             self.osd.log_exc(f"pg {self.pgid} op {m.op}")
             reply = M.MOSDOpReply(tid=m.tid, result=M.EAGAIN, data=b"",
                                   size=0, epoch=self.osd.osdmap.epoch)
+        perf.tinc("op_latency", time.perf_counter() - t0)
         await self.osd.send(src, reply)
 
     # ------------------------------------------------------------- writes
@@ -454,6 +460,7 @@ class PG:
             self.log.trim(self.osd.log_keep)
         self._persist_log(full)
         self.osd.store.queue_transaction(full)
+        self.osd.perf.inc("subop_w")
         await self.osd.send(
             src,
             M.MOSDRepOpReply(tid=m.tid, pgid=self.pgid, result=M.OK,
@@ -472,6 +479,7 @@ class PG:
             self.log.trim(self.osd.log_keep)
         self._persist_log(full)
         self.osd.store.queue_transaction(full)
+        self.osd.perf.inc("subop_w")
         await self.osd.send(
             src,
             M.MECSubWriteReply(tid=m.tid, pgid=self.pgid, shard=m.shard,
@@ -683,6 +691,7 @@ class PG:
                 attrs = osd.store.getattrs(self.cid, oid)
             except Exception:
                 return  # deleted meanwhile
+        osd.perf.inc("recovery_pushes")
         fut = osd.expect_reply(("pushr", self.pgid, s, oid, o))
         await osd.send(
             f"osd.{o}",
@@ -841,6 +850,7 @@ class PG:
         osd = self.osd
         if not self.is_primary() or self.state != "active":
             raise RuntimeError("scrub requires an active primary")
+        osd.perf.inc("scrubs")
         peers = [(o, s) for o, s in self.live_members() if o != osd.id]
         maps: dict[tuple[int, int], dict] = {}
         bad: dict[tuple[int, int], set[bytes]] = {}

@@ -1,0 +1,220 @@
+"""Config system: typed option schema + proxy with change observers
+(the src/common/config.h + src/common/options/*.yaml.in role).
+
+Options are declared once in a schema (type, default, bounds, enum,
+level, description — the yaml.in fields that matter at runtime);
+ConfigProxy gives typed get/set with validation, tracks which values
+were explicitly set, and fires registered observers on change the way
+md_config_obs_t subscribers re-read their cached values
+(e.g. BlueStore re-reading bluestore_csum_type, BlueStore.cc:4715).
+
+Sources are layered like the reference (defaults < file < env < cli <
+runtime `set`), collapsed eagerly: the last write wins, `reset` returns
+an option to its default.
+"""
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Iterable
+
+
+class ConfigError(Exception):
+    pass
+
+
+_TYPES = {
+    "str": str,
+    "int": int,
+    "float": float,
+    "bool": bool,
+    "size": int,   # bytes; accepts "4K", "1M" style strings
+    "secs": float,
+}
+
+_SIZE_SUFFIX = {"k": 1 << 10, "m": 1 << 20, "g": 1 << 30, "t": 1 << 40}
+
+
+@dataclass(frozen=True)
+class Option:
+    name: str
+    type: str = "str"
+    default: Any = None
+    desc: str = ""
+    min: float | None = None
+    max: float | None = None
+    enum: tuple = ()
+    #: runtime-updatable (the yaml `flags: runtime` marker); non-runtime
+    #: options reject set() after freeze()
+    runtime: bool = True
+
+    def coerce(self, value: Any) -> Any:
+        if self.type not in _TYPES:
+            raise ConfigError(f"{self.name}: unknown type {self.type!r}")
+        if self.type == "bool":
+            if isinstance(value, str):
+                v = value.lower()
+                if v in ("true", "yes", "1", "on"):
+                    return True
+                if v in ("false", "no", "0", "off"):
+                    return False
+                raise ConfigError(f"{self.name}: bad bool {value!r}")
+            return bool(value)
+        if self.type == "size" and isinstance(value, str):
+            s = value.strip().lower().rstrip("ib")
+            if s and s[-1] in _SIZE_SUFFIX:
+                value = int(float(s[:-1]) * _SIZE_SUFFIX[s[-1]])
+            else:
+                value = int(s)
+        try:
+            out = _TYPES[self.type](value)
+        except (TypeError, ValueError) as e:
+            raise ConfigError(
+                f"{self.name}: cannot parse {value!r} as {self.type}"
+            ) from e
+        if self.enum and out not in self.enum:
+            raise ConfigError(
+                f"{self.name}: {out!r} not in {self.enum}"
+            )
+        if self.min is not None and out < self.min:
+            raise ConfigError(f"{self.name}: {out} < min {self.min}")
+        if self.max is not None and out > self.max:
+            raise ConfigError(f"{self.name}: {out} > max {self.max}")
+        return out
+
+
+class Schema:
+    def __init__(self, options: Iterable[Option] = ()):
+        self._options: dict[str, Option] = {}
+        for o in options:
+            self.add(o)
+
+    def add(self, option: Option) -> None:
+        if option.name in self._options:
+            raise ConfigError(f"duplicate option {option.name!r}")
+        self._options[option.name] = option
+
+    def get(self, name: str) -> Option:
+        try:
+            return self._options[name]
+        except KeyError:
+            raise ConfigError(f"unknown option {name!r}") from None
+
+    def names(self) -> list[str]:
+        return sorted(self._options)
+
+
+class ConfigProxy:
+    """Typed live view over a Schema with observers."""
+
+    def __init__(self, schema: Schema):
+        self.schema = schema
+        self._values: dict[str, Any] = {}
+        self._observers: dict[str, list[Callable[[str, Any], None]]] = {}
+        self._frozen = False
+        self._lock = threading.RLock()
+
+    # -------------------------------------------------------------- get
+
+    def get(self, name: str) -> Any:
+        opt = self.schema.get(name)
+        with self._lock:
+            if name in self._values:
+                return self._values[name]
+        return opt.coerce(opt.default) if opt.default is not None else None
+
+    def __getitem__(self, name: str) -> Any:
+        return self.get(name)
+
+    def is_set(self, name: str) -> bool:
+        self.schema.get(name)
+        return name in self._values
+
+    # -------------------------------------------------------------- set
+
+    def set(self, name: str, value: Any) -> None:
+        opt = self.schema.get(name)
+        if self._frozen and not opt.runtime:
+            raise ConfigError(
+                f"{name} is not runtime-updatable (restart required)"
+            )
+        coerced = opt.coerce(value)
+        with self._lock:
+            old = self.get(name)
+            self._values[name] = coerced
+            observers = list(self._observers.get(name, ()))
+        if coerced != old:
+            for cb in observers:
+                cb(name, coerced)
+
+    def reset(self, name: str) -> None:
+        self.schema.get(name)
+        with self._lock:
+            self._values.pop(name, None)
+
+    def apply(self, values: dict[str, Any]) -> None:
+        for k, v in values.items():
+            self.set(k, v)
+
+    def freeze(self) -> None:
+        """Boot finished: non-runtime options lock (the mon pushes only
+        runtime-updatable changes to live daemons)."""
+        self._frozen = True
+
+    # -------------------------------------------------------- observers
+
+    def observe(self, name: str, cb: Callable[[str, Any], None]) -> None:
+        """md_config_obs_t role: cb(name, new_value) fires on change."""
+        self.schema.get(name)
+        with self._lock:
+            self._observers.setdefault(name, []).append(cb)
+
+    # ------------------------------------------------------------- dump
+
+    def show(self) -> dict[str, Any]:
+        """`config show` role: every option's effective value."""
+        return {n: self.get(n) for n in self.schema.names()}
+
+    def diff(self) -> dict[str, Any]:
+        """`config diff` role: only explicitly-set values."""
+        with self._lock:
+            return dict(self._values)
+
+
+# ------------------------------------------------- framework defaults
+
+SCHEMA = Schema([
+    Option("osd_heartbeat_interval", "secs", 0.25,
+           desc="OSD->mon ping period", min=0.001),
+    Option("osd_heartbeat_grace", "secs", 2.0,
+           desc="silence before an OSD is reported down", min=0.01),
+    Option("mon_osd_down_out_interval", "secs", 4.0,
+           desc="down this long -> out (weight 0, data re-flows)"),
+    Option("osd_pg_log_keep", "int", 128,
+           desc="PGLog entries retained for delta recovery", min=1),
+    Option("osd_subop_timeout", "secs", 3.0,
+           desc="peer sub-op reply deadline", min=0.01),
+    Option("osd_ec_batch_window", "secs", 0.0,
+           desc="extra wait to accrete EC stripes into one device batch"),
+    Option("store_kind", "str", "memstore",
+           enum=("memstore", "walstore"), runtime=False,
+           desc="ObjectStore backend for OSD-lite daemons"),
+    Option("walstore_fsync", "bool", False, runtime=False,
+           desc="fsync the WAL on every commit"),
+    Option("walstore_compact_bytes", "size", 64 << 20,
+           desc="WAL size that triggers a checkpoint", min=4096),
+    Option("bluestore_csum_type", "str", "crc32c",
+           enum=("none", "crc32c", "crc32c_16", "crc32c_8",
+                 "xxhash32", "xxhash64"),
+           desc="blob checksum algorithm (Checksummer)"),
+    Option("debug_default", "int", 1, desc="default log level",
+           min=0, max=20),
+    Option("ec_device_backend", "bool", True,
+           desc="route EC encode/decode through the TPU kernels"),
+])
+
+
+def proxy() -> ConfigProxy:
+    """Fresh proxy over the framework schema (per-daemon, like each
+    daemon's md_config_t)."""
+    return ConfigProxy(SCHEMA)

@@ -1,0 +1,176 @@
+"""L0 infrastructure tests: config schema/proxy/observers, perf
+counters, logging ring, admin socket, and their wiring into a live
+OSD daemon."""
+import asyncio
+import io
+
+import pytest
+
+from ceph_tpu.utils import config as cfg
+from ceph_tpu.utils.admin import AdminSocket, admin_command
+from ceph_tpu.utils.log import Log
+from ceph_tpu.utils.perf import PerfCounters, PerfCountersCollection
+
+
+# ------------------------------------------------------------- config
+
+
+def test_config_defaults_and_types():
+    c = cfg.proxy()
+    assert c["osd_heartbeat_interval"] == 0.25
+    assert c["osd_pg_log_keep"] == 128
+    assert c["store_kind"] == "memstore"
+    with pytest.raises(cfg.ConfigError):
+        c.get("no_such_option")
+
+
+def test_config_set_validate():
+    c = cfg.proxy()
+    c.set("osd_pg_log_keep", "256")
+    assert c["osd_pg_log_keep"] == 256
+    with pytest.raises(cfg.ConfigError):
+        c.set("osd_pg_log_keep", 0)  # min 1
+    with pytest.raises(cfg.ConfigError):
+        c.set("store_kind", "rocks")  # enum
+    c.set("walstore_compact_bytes", "8K")
+    assert c["walstore_compact_bytes"] == 8192
+    c.set("walstore_fsync", "yes")
+    assert c["walstore_fsync"] is True
+    c.reset("osd_pg_log_keep")
+    assert c["osd_pg_log_keep"] == 128
+    assert not c.is_set("osd_pg_log_keep")
+
+
+def test_config_observers_fire_on_change():
+    c = cfg.proxy()
+    seen = []
+    c.observe("osd_heartbeat_grace", lambda n, v: seen.append((n, v)))
+    c.set("osd_heartbeat_grace", 5.0)
+    c.set("osd_heartbeat_grace", 5.0)  # no change -> no fire
+    c.set("osd_heartbeat_grace", 6.0)
+    assert seen == [("osd_heartbeat_grace", 5.0),
+                    ("osd_heartbeat_grace", 6.0)]
+
+
+def test_config_freeze_blocks_non_runtime():
+    c = cfg.proxy()
+    c.set("store_kind", "walstore")  # fine before freeze
+    c.freeze()
+    with pytest.raises(cfg.ConfigError):
+        c.set("store_kind", "memstore")
+    c.set("osd_heartbeat_grace", 9.0)  # runtime ok
+    assert c.diff()["store_kind"] == "walstore"
+
+
+# --------------------------------------------------------------- perf
+
+
+def test_perf_counters():
+    p = PerfCounters("osd.0")
+    p.add_u64_counter("ops")
+    p.add_gauge("load")
+    p.add_time_avg("lat")
+    p.add_histogram("batch")
+    p.inc("ops")
+    p.inc("ops", 4)
+    p.set("load", 0.7)
+    p.tinc("lat", 0.5)
+    p.tinc("lat", 1.5)
+    p.observe("batch", 3)
+    p.observe("batch", 100)
+    d = p.dump()
+    assert d["ops"] == 5
+    assert d["load"] == 0.7
+    assert d["lat"] == {"avgcount": 2, "sum": 2.0}
+    assert d["batch"]["count"] == 2 and d["batch"]["sum"] == 103
+    with p.time("lat"):
+        pass
+    assert p.dump()["lat"]["avgcount"] == 3
+
+
+def test_perf_collection():
+    coll = PerfCountersCollection()
+    a = coll.create("osd.0")
+    a.add_u64_counter("x")
+    a.inc("x")
+    b = coll.create("mon")
+    b.add_gauge("y")
+    d = coll.dump()
+    assert d == {"mon": {"y": 0}, "osd.0": {"x": 1}}
+    coll.remove("mon")
+    assert "mon" not in coll.dump()
+
+
+# ---------------------------------------------------------------- log
+
+
+def test_log_levels_and_ring():
+    buf = io.StringIO()
+    log = Log(default_level=1, gather_level=10, ring_size=100,
+              stream=buf)
+    log.dout("osd", 1, "printed")
+    log.dout("osd", 5, "gathered only")
+    log.dout("osd", 15, "dropped entirely")
+    out = buf.getvalue()
+    assert "printed" in out and "gathered" not in out
+    recent = log.dump_recent()
+    assert len(recent) == 2  # printed + gathered, not the dropped one
+    assert "gathered only" in recent[-1]
+    log.set_level("osd", 5)
+    log.dout("osd", 5, "now visible")
+    assert "now visible" in buf.getvalue()
+
+
+# ------------------------------------------------------- admin socket
+
+
+def test_admin_socket_roundtrip(tmp_path):
+    async def t():
+        sock = AdminSocket(str(tmp_path / "a.sock"))
+        sock.register("echo", lambda a: {"you said": a.get("msg")})
+        await sock.start()
+        got = await admin_command(sock.path, "echo", msg="hi")
+        assert got == {"you said": "hi"}
+        helped = await admin_command(sock.path, "help")
+        assert "echo" in helped
+        with pytest.raises(RuntimeError):
+            await admin_command(sock.path, "nope")
+        await sock.stop()
+
+    asyncio.run(asyncio.wait_for(t(), 30))
+
+
+def test_osd_admin_socket_live_cluster(tmp_path):
+    from ceph_tpu.cluster.vstart import TestCluster
+    from ceph_tpu.placement.osdmap import Pool
+
+    async def t():
+        c = TestCluster(n_osds=3)
+        await c.start()
+        await c.client.create_pool(
+            Pool(id=1, name="p", size=3, pg_num=4, crush_rule=0)
+        )
+        await c.wait_active(20)
+        await c.client.write_full(1, "x", b"payload")
+        assert await c.client.read(1, "x") == b"payload"
+        osd = c.osds[0]
+        await osd.start_admin(str(tmp_path / "osd0.sock"))
+        perf = await admin_command(osd.admin.path, "perf dump")
+        assert perf["map_epochs"] >= 1
+        status = await admin_command(osd.admin.path, "status")
+        assert status["osd"] == 0 and status["pgs"] > 0
+        pgs = await admin_command(osd.admin.path, "dump_pgs")
+        assert all(v["state"] == "active" for v in pgs.values())
+        conf = await admin_command(osd.admin.path, "config show")
+        assert conf["osd_pg_log_keep"] == 128
+        await admin_command(osd.admin.path, "config set",
+                            key="osd_subop_timeout", value=7)
+        assert osd.subop_timeout == 7.0
+        # ops were counted on whichever OSD is the primary
+        total_ops = 0
+        for o in c.osds:
+            total_ops += o.perf.dump()["op"]
+        assert total_ops >= 2
+        await c.stop()
+
+    asyncio.run(asyncio.wait_for(t(), 60))
