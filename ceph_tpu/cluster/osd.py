@@ -29,6 +29,7 @@ from ..utils.fault import FaultInjector
 from ..utils.perf import PerfCounters
 from . import messages as M
 from .pg import NONE, PG
+from .scheduler import CLIENT, RECOVERY, SCRUB, MClockScheduler, Throttle
 
 _FAILED = object()
 
@@ -124,10 +125,14 @@ class OSDLite:
         self._declare_counters()
         self.ec_batcher = ECBatcher(self.perf)
         self.admin: AdminSocket | None = None
+        # QoS between client / recovery / scrub traffic (mClock role)
+        self.op_scheduler = MClockScheduler()
+        self.throttle = Throttle(self.conf["osd_client_message_size_cap"])
         self.pending: dict = {}  # key -> Future (sub-op replies)
         self._subtid = 0
         self._codecs: dict[int, object] = {}
         self._hb_task: asyncio.Task | None = None
+        self._worker_task: asyncio.Task | None = None
         self._tasks: set[asyncio.Task] = set()
         self.stopped = False
 
@@ -235,6 +240,21 @@ class OSDLite:
         self._hb_task = asyncio.get_running_loop().create_task(
             self._hb_loop()
         )
+        self._worker_task = asyncio.get_running_loop().create_task(
+            self._op_worker()
+        )
+
+    async def _op_worker(self) -> None:
+        """Drain the mClock queue (the ShardedOpWQ::_process role,
+        OSD.cc:10859): one decision at a time, QoS between classes."""
+        while True:
+            fn = await self.op_scheduler.get()
+            try:
+                await fn()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                self.log_exc("op worker")
 
     async def start_admin(self, path: str) -> None:
         """Expose the daemon on an admin socket (`ceph daemon` role)."""
@@ -275,6 +295,8 @@ class OSDLite:
             self.admin = None
         if self._hb_task:
             self._hb_task.cancel()
+        if self._worker_task:
+            self._worker_task.cancel()
         for t in list(self._tasks):
             t.cancel()
         self.bus.unregister(self.name)
@@ -307,15 +329,28 @@ class OSDLite:
         if isinstance(msg, M.MOSDMapMsg):
             await self._handle_map(msg)
         elif isinstance(msg, M.MOSDOp):
-            pg = self._pg_for_primary(msg.pgid)
-            if pg is None:
-                await self.send(
-                    src,
-                    M.MOSDOpReply(tid=msg.tid, result=M.ESTALE, data=b"",
-                                  size=0, epoch=self.epoch),
-                )
-                return
-            await pg.do_op(src, msg)
+            # enqueue_op role: client ops take the mClock queue under
+            # the ingest byte throttle; sub-ops and control traffic stay
+            # fast-dispatch
+            await self.throttle.acquire(len(msg.data))
+            self.op_scheduler.enqueue(
+                CLIENT, lambda src=src, msg=msg: self._client_op(src, msg)
+            )
+        elif isinstance(msg, M.MPull):
+            pg = self._ensure_pg(msg.pgid, msg.shard)
+            self.op_scheduler.enqueue(
+                RECOVERY, lambda: pg.handle_pull(src, msg)
+            )
+        elif isinstance(msg, M.MPGScan):
+            pg = self._ensure_pg(msg.pgid, msg.shard)
+            self.op_scheduler.enqueue(
+                RECOVERY, lambda: pg.handle_scan(src, msg)
+            )
+        elif isinstance(msg, M.MScrub):
+            pg = self._ensure_pg(msg.pgid, msg.shard)
+            self.op_scheduler.enqueue(
+                SCRUB, lambda: pg.handle_scrub(src, msg)
+            )
         elif isinstance(msg, M.MOSDRepOp):
             pg = self._ensure_pg(msg.pgid, -1)
             await pg.handle_rep_op(src, msg)
@@ -337,15 +372,9 @@ class OSDLite:
         elif isinstance(msg, M.MPGInfoReply):
             osd_id = int(src[4:])
             self._resolve(("info", msg.pgid, osd_id, msg.shard), msg)
-        elif isinstance(msg, M.MPGScan):
-            pg = self._ensure_pg(msg.pgid, msg.shard)
-            await pg.handle_scan(src, msg)
         elif isinstance(msg, M.MPGScanReply):
             osd_id = int(src[4:])
             self._resolve(("scan", msg.pgid, osd_id, msg.shard), msg)
-        elif isinstance(msg, M.MPull):
-            pg = self._ensure_pg(msg.pgid, msg.shard)
-            await pg.handle_pull(src, msg)
         elif isinstance(msg, M.MPushOp):
             # two roles: a primary pushing recovery to us, or the answer
             # to our own MPull (self-recovery) — resolve a pending pull
@@ -363,11 +392,22 @@ class OSDLite:
             osd_id = int(src[4:])
             self._resolve(("pushr", msg.pgid, msg.shard, msg.oid, osd_id),
                           msg)
-        elif isinstance(msg, M.MScrub):
-            pg = self._ensure_pg(msg.pgid, msg.shard)
-            await pg.handle_scrub(src, msg)
         elif isinstance(msg, M.MScrubReply):
             self._resolve(msg.tid, msg)
+
+    async def _client_op(self, src: str, msg: M.MOSDOp) -> None:
+        try:
+            pg = self._pg_for_primary(msg.pgid)
+            if pg is None:
+                await self.send(
+                    src,
+                    M.MOSDOpReply(tid=msg.tid, result=M.ESTALE, data=b"",
+                                  size=0, epoch=self.epoch),
+                )
+                return
+            await pg.do_op(src, msg)
+        finally:
+            self.throttle.release(len(msg.data))
 
     def _my_shard(self, pgid, msg_shard: int) -> int:
         """The shard *this* OSD holds for pgid (push messages carry the

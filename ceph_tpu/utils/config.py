@@ -207,6 +207,8 @@ SCHEMA = Schema([
            enum=("none", "crc32c", "crc32c_16", "crc32c_8",
                  "xxhash32", "xxhash64"),
            desc="blob checksum algorithm (Checksummer)"),
+    Option("osd_client_message_size_cap", "size", 64 << 20,
+           desc="in-flight client payload bytes before ingest throttles"),
     Option("debug_default", "int", 1, desc="default log level",
            min=0, max=20),
     Option("ec_device_backend", "bool", True,

@@ -1,0 +1,130 @@
+"""mClock scheduler + Throttle tests (TestMClockScheduler role)."""
+import asyncio
+
+import pytest
+
+from ceph_tpu.cluster.scheduler import (
+    BEST_EFFORT,
+    CLIENT,
+    RECOVERY,
+    MClockScheduler,
+    Throttle,
+)
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+
+def drain(s, n):
+    out = []
+    for _ in range(n):
+        item = s.dequeue()
+        if item is None:
+            break
+        out.append(item)
+    return out
+
+
+def test_reservation_served_first():
+    clk = FakeClock()
+    s = MClockScheduler({
+        CLIENT: (10.0, 1.0, 0.0),     # r advances 0.1s/op
+        RECOVERY: (1.0, 1.0, 0.0),    # r advances 1s/op
+    }, clock=clk)
+    for i in range(3):
+        s.enqueue(CLIENT, f"c{i}")
+    s.enqueue(RECOVERY, "r0")
+    clk.t += 10  # everything's reservation tag is due
+    got = drain(s, 4)
+    assert set(got) == {"c0", "c1", "c2", "r0"}
+    # order respects reservation tags: client ops (0.1 spacing) precede
+    # the recovery op's 1s tag only where tags are smaller; first out
+    # must be a client op
+    assert got[0] == "c0"
+
+
+def test_weight_shares_spare_capacity():
+    clk = FakeClock()
+    s = MClockScheduler({
+        # zero reservation -> everything is weight-phase
+        CLIENT: (0.0, 4.0, 0.0),    # p advances 0.25/op
+        RECOVERY: (0.0, 1.0, 0.0),  # p advances 1.0/op
+    }, clock=clk)
+    for i in range(8):
+        s.enqueue(CLIENT, f"c{i}")
+    for i in range(8):
+        s.enqueue(RECOVERY, f"r{i}")
+    got = drain(s, 10)
+    # 4:1 weights -> in the first 10 decisions client gets ~4x slots
+    assert got.count("r0") + got.count("r1") <= 2
+    assert sum(1 for g in got if g.startswith("c")) >= 8 - 1
+
+
+def test_limit_defers_eligibility():
+    clk = FakeClock()
+    s = MClockScheduler({
+        RECOVERY: (0.0, 1.0, 2.0),  # limit 2 ops/s -> l_tag 0.5 apart
+    }, clock=clk)
+    for i in range(4):
+        s.enqueue(RECOVERY, f"r{i}")
+    # l_tags clamp to now then advance 0.5 apart: r0 due immediately,
+    # the rest gated at now+0.5, now+1.0, now+1.5
+    assert drain(s, 10) == ["r0"]
+    assert s.dequeue() is None  # limited
+    clk.t += 0.5
+    assert s.dequeue() == "r1"
+    assert s.dequeue() is None
+    clk.t += 10
+    assert drain(s, 10) == ["r2", "r3"]
+    assert len(s) == 0
+
+
+def test_idle_class_does_not_bank_credit():
+    clk = FakeClock()
+    s = MClockScheduler({
+        BEST_EFFORT: (0.0, 1.0, 1.0),
+    }, clock=clk)
+    s.enqueue(BEST_EFFORT, "a")
+    assert drain(s, 1) == ["a"]
+    clk.t += 1000  # long idle must not allow a burst past the limit
+    for i in range(5):
+        s.enqueue(BEST_EFFORT, f"b{i}")
+    assert len(drain(s, 10)) <= 2  # ~1/s: only the clamped head is due
+
+
+def test_async_get():
+    async def t():
+        s = MClockScheduler({CLIENT: (100.0, 1.0, 0.0)})
+        s.enqueue(CLIENT, "x")
+        assert await asyncio.wait_for(s.get(), 5) == "x"
+        fut = asyncio.ensure_future(s.get())
+        await asyncio.sleep(0.05)
+        assert not fut.done()
+        s.enqueue(CLIENT, "y")
+        assert await asyncio.wait_for(fut, 5) == "y"
+
+    asyncio.run(t())
+
+
+def test_throttle():
+    async def t():
+        th = Throttle(100)
+        await th.acquire(60)
+        await th.acquire(40)
+        assert th.past_midpoint()
+        blocked = asyncio.ensure_future(th.acquire(10))
+        await asyncio.sleep(0.02)
+        assert not blocked.done()
+        th.release(60)
+        await asyncio.wait_for(blocked, 5)
+        th.release(50)
+        # oversized request admitted alone when empty
+        await asyncio.wait_for(th.acquire(1000), 5)
+        th.release(1000)
+
+    asyncio.run(t())
