@@ -41,8 +41,9 @@ from .memstore import MemStore
 WAL_NAME = "wal.log"
 SNAP_NAME = "snap"
 SNAP_MAGIC = 0x53_50_55_54  # "TUPS" — snapshot header magic
-SNAP_VERSION = 1
+SNAP_VERSION = 2  # v2: per-object compression flag byte
 CSUM_BLOCK = 4096
+MIN_COMPRESS_BLOB = 4096  # bluestore_compression_min_blob_size role
 
 
 class WalStore(MemStore):
@@ -50,12 +51,21 @@ class WalStore(MemStore):
 
     def __init__(self, path: str, fsync: bool = False,
                  device_csum: bool = False,
-                 wal_compact_bytes: int = 64 << 20):
+                 wal_compact_bytes: int = 64 << 20,
+                 compression: str | None = "zlib"):
         super().__init__()
         self.path = path
         self.fsync = fsync
         self.device_csum = device_csum
         self.wal_compact_bytes = wal_compact_bytes
+        # checkpoint blob compression (bluestore_compression_algorithm
+        # role); checksums stay over the RAW bytes so rot is attributed
+        # to data, not codec framing
+        self._comp = None
+        if compression:
+            from ..utils import compress as comp_mod
+
+            self._comp = comp_mod.create(compression)
         self._wal = None
         self._wal_size = 0
         self._seq = 0  # last applied transaction sequence number
@@ -228,7 +238,16 @@ class WalStore(MemStore):
                 obj_crcs = crcs[bi : bi + nb]
                 bi += nb
                 parts.append(denc.enc_bytes(oid))
-                parts.append(denc.enc_bytes(bytes(o.data)))
+                raw = bytes(o.data)
+                stored, flag = raw, 0
+                if self._comp is not None and len(raw) >= MIN_COMPRESS_BLOB:
+                    from ..utils.compress import compress_blob
+
+                    packed = compress_blob(self._comp, raw)
+                    if packed is not None:
+                        stored, flag = packed, 1
+                parts.append(denc.enc_u8(flag))
+                parts.append(denc.enc_bytes(stored))
                 parts.append(
                     denc.enc_list(
                         [int(v) for v in obj_crcs],
@@ -262,7 +281,15 @@ class WalStore(MemStore):
             c = Collection(cid)
             for _ in range(nobj):
                 oid, off = denc.dec_bytes(buf, off)
+                flag, off = denc.dec_u8(buf, off)
                 data, off = denc.dec_bytes(buf, off)
+                if flag:
+                    if self._comp is None:
+                        raise StoreError(
+                            "snapshot is compressed but store opened "
+                            "without compression"
+                        )
+                    data = self._comp.decompress(data)
                 crc_list, off = denc.dec_list(buf, off, denc.dec_u32)
                 xattrs, off = denc.dec_map(
                     buf, off, denc.dec_str, denc.dec_bytes

@@ -1,0 +1,111 @@
+"""Compressor plugin layer + SloppyCRCMap tests."""
+import numpy as np
+import pytest
+
+from ceph_tpu.utils import compress as C
+from ceph_tpu.utils.sloppy_crc import SloppyCRCMap
+
+
+@pytest.mark.parametrize("name", ["zlib", "bz2", "lzma"])
+def test_compressor_roundtrip(name):
+    comp = C.create(name)
+    data = b"the quick brown fox " * 500
+    packed = comp.compress(data)
+    assert len(packed) < len(data)
+    assert comp.decompress(packed) == data
+
+
+def test_compressor_corrupt_stream():
+    comp = C.create("zlib")
+    packed = bytearray(comp.compress(b"x" * 10000))
+    packed[5] ^= 0xFF
+    with pytest.raises(C.CompressError):
+        comp.decompress(bytes(packed))
+
+
+def test_unknown_compressor():
+    with pytest.raises(C.CompressError):
+        C.create("snappy9000")
+    assert "zlib" in C.names()
+
+
+def test_compression_modes():
+    assert not C.should_compress(C.MODE_NONE, C.HINT_COMPRESSIBLE)
+    assert C.should_compress(C.MODE_FORCE, C.HINT_INCOMPRESSIBLE)
+    assert C.should_compress(C.MODE_PASSIVE, C.HINT_COMPRESSIBLE)
+    assert not C.should_compress(C.MODE_PASSIVE, C.HINT_NONE)
+    assert C.should_compress(C.MODE_AGGRESSIVE, C.HINT_NONE)
+    assert not C.should_compress(C.MODE_AGGRESSIVE, C.HINT_INCOMPRESSIBLE)
+
+
+def test_compress_blob_ratio_gate():
+    comp = C.create("zlib")
+    assert C.compress_blob(comp, b"A" * 8192) is not None
+    incompressible = np.random.default_rng(1).integers(
+        0, 256, 8192, dtype=np.uint8
+    ).tobytes()
+    assert C.compress_blob(comp, incompressible) is None
+
+
+def test_walstore_compressed_snapshot(tmp_path):
+    from ceph_tpu.store import Transaction
+    from ceph_tpu.store.walstore import WalStore
+
+    s = WalStore(str(tmp_path / "s"), compression="zlib")
+    s.mount()
+    t = Transaction().create_collection("c")
+    t.write("c", b"big", 0, b"Z" * 100_000)  # compressible
+    t.write("c", b"small", 0, b"tiny")
+    s.apply_transaction(t)
+    s.umount()
+    import os
+
+    snap_size = os.path.getsize(str(tmp_path / "s" / "snap"))
+    assert snap_size < 10_000  # 100 KB of Zs squashed
+    s2 = WalStore(str(tmp_path / "s"), compression="zlib")
+    s2.mount()
+    assert s2.read("c", b"big") == b"Z" * 100_000
+    assert s2.read("c", b"small") == b"tiny"
+    s2.umount()
+
+
+# ------------------------------------------------------- SloppyCRCMap
+
+
+def test_sloppy_full_block_writes_tracked():
+    m = SloppyCRCMap(block_size=16)
+    data = bytes(range(64))
+    m.write(0, data)
+    assert len(m.crc) == 4
+    assert m.read_check(0, data) == []
+    bad = bytearray(data)
+    bad[20] ^= 1
+    assert m.read_check(0, bytes(bad)) == [16]
+
+
+def test_sloppy_partial_write_invalidates():
+    m = SloppyCRCMap(block_size=16)
+    m.write(0, bytes(64))
+    m.write(8, b"xy")  # partial: block 0 forgotten
+    assert 0 not in m.crc and 1 in m.crc
+    # a check over a forgotten block reports nothing (sloppy contract)
+    junk = b"j" * 16 + bytes(48)
+    assert m.read_check(0, junk) == []
+
+
+def test_sloppy_zero_truncate():
+    m = SloppyCRCMap(block_size=16)
+    m.write(0, bytes(range(16)) * 4)
+    m.zero(16, 16)
+    assert m.read_check(16, bytes(16)) == []
+    m.truncate(40)  # cuts block 2 partially, drops block 3
+    assert 3 not in m.crc and 2 not in m.crc
+    assert 0 in m.crc and 1 in m.crc
+
+
+def test_sloppy_encode_decode():
+    m = SloppyCRCMap(block_size=32)
+    m.write(0, bytes(range(128)))
+    m2, used = SloppyCRCMap.decode(m.encode())
+    assert used == len(m.encode())
+    assert m2.block_size == 32 and m2.crc == m.crc

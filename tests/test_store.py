@@ -257,7 +257,9 @@ def test_walstore_crash_inside_compact(tmp_path):
 def test_walstore_snapshot_csum_detects_corruption(tmp_path):
     """Blob checksums (calc_csum/verify_csum role) catch bit rot in the
     checkpoint file."""
-    s = make_walstore(tmp_path)
+    # compression off so raw data bytes are findable in the snapshot
+    s = WalStore(str(tmp_path / "store"), compression=None)
+    s.mount()
     t = tx.Transaction().create_collection("c")
     t.write("c", b"a", 0, b"Z" * 10000)
     s.apply_transaction(t)
@@ -268,7 +270,7 @@ def test_walstore_snapshot_csum_detects_corruption(tmp_path):
     assert idx > 0
     blob[idx + 50] ^= 0x01
     open(snap, "wb").write(bytes(blob))
-    s2 = WalStore(str(tmp_path / "store"))
+    s2 = WalStore(str(tmp_path / "store"), compression=None)
     with pytest.raises(StoreError, match="csum mismatch"):
         s2.mount()
 
