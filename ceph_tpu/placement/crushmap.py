@@ -28,6 +28,9 @@ ITEM_NONE = 0x7FFFFFFF  # crush.h:36
 
 ALG_UNIFORM = "uniform"
 ALG_STRAW2 = "straw2"
+ALG_LIST = "list"
+ALG_TREE = "tree"
+ALG_STRAW = "straw"  # legacy straw1 (pre-jewel maps)
 
 # rule step ops (crush.h rule ops)
 OP_TAKE = "take"
@@ -60,6 +63,11 @@ class Bucket:
     items: list[int] = field(default_factory=list)
     weights: list[int] = field(default_factory=list)  # 16.16 fixed per item
     name: str = ""
+    # derived per-alg state, computed by add_bucket (the builder.c role):
+    # straw scalers (straw1), cumulative sums (list), node weights (tree)
+    straws: list[int] = field(default_factory=list)
+    sum_weights: list[int] = field(default_factory=list)
+    node_weights: list[int] = field(default_factory=list)
 
     @property
     def size(self) -> int:
@@ -67,6 +75,120 @@ class Bucket:
 
     def weight(self) -> int:
         return sum(self.weights)
+
+
+def calc_straw_scalers(weights: list[int]) -> list[int]:
+    """crush_calc_straw (builder.c:430, straw_calc_version 1): reverse
+    weight-sorted items get exponentially growing straw scalers so draw
+    probabilities track weights."""
+    size = len(weights)
+    order = sorted(range(size), key=lambda i: (weights[i], i))
+    straws = [0] * size
+    numleft = size
+    straw = 1.0
+    wbelow = 0.0
+    lastw = 0.0
+    i = 0
+    while i < size:
+        if weights[order[i]] == 0:
+            straws[order[i]] = 0
+            i += 1
+            numleft -= 1
+            continue
+        straws[order[i]] = int(straw * 0x10000)
+        i += 1
+        if i == size:
+            break
+        wbelow += (float(weights[order[i - 1]]) - lastw) * numleft
+        numleft -= 1
+        wnext = numleft * (weights[order[i]] - weights[order[i - 1]])
+        if wbelow + wnext > 0 and wbelow > 0:
+            pbelow = wbelow / (wbelow + wnext)
+            if pbelow > 0 and numleft > 0:
+                straw *= (1.0 / pbelow) ** (1.0 / numleft)
+        lastw = float(weights[order[i - 1]])
+    return straws
+
+
+def _tree_depth(size: int) -> int:
+    depth = 1
+    t = size - 1
+    while t:
+        t >>= 1
+        depth += 1
+    return depth
+
+
+def _tree_height(n: int) -> int:
+    h = 0
+    while (n & 1) == 0:
+        h += 1
+        n >>= 1
+    return h
+
+
+def _tree_left(x: int) -> int:
+    return x - (1 << (_tree_height(x) - 1))
+
+
+def _tree_right(x: int) -> int:
+    return x + (1 << (_tree_height(x) - 1))
+
+
+def calc_tree_nodes(weights: list[int]) -> list[int]:
+    """crush_make_tree_bucket node-weight layout: leaf i sits at node
+    2i+1; internal nodes accumulate their subtree weights."""
+    size = len(weights)
+    if size == 0:
+        return []
+    depth = _tree_depth(size)
+    nodes = [0] * (1 << depth)
+    for i, wgt in enumerate(weights):
+        node = ((i + 1) << 1) - 1
+        nodes[node] = wgt
+        for _ in range(1, depth):
+            node = _tree_parent(node)
+            nodes[node] += wgt
+    return nodes
+
+
+def _tree_parent(n: int) -> int:
+    h = _tree_height(n)
+    return n - (1 << h) if n & (1 << (h + 1)) else n + (1 << h)
+
+
+# rjenkins1 4-input hash (src/crush/hash.c rjenkins1_4 recipe — frozen
+# interoperability constants, like the 2/3-input variants in the native
+# core)
+def _hashmix(a: int, b: int, c: int) -> tuple[int, int, int]:
+    M = 0xFFFFFFFF
+    a = (a - b - c) & M; a ^= c >> 13  # noqa: E702
+    b = (b - c - a) & M; b ^= (a << 8) & M  # noqa: E702
+    c = (c - a - b) & M; c ^= b >> 13  # noqa: E702
+    a = (a - b - c) & M; a ^= c >> 12  # noqa: E702
+    b = (b - c - a) & M; b ^= (a << 16) & M  # noqa: E702
+    c = (c - a - b) & M; c ^= b >> 5  # noqa: E702
+    a = (a - b - c) & M; a ^= c >> 3  # noqa: E702
+    b = (b - c - a) & M; b ^= (a << 10) & M  # noqa: E702
+    c = (c - a - b) & M; c ^= b >> 15  # noqa: E702
+    return a, b, c
+
+
+_HASH_SEED = 1315423911
+
+
+def crush_hash32_4(a: int, b: int, c: int, d: int) -> int:
+    M = 0xFFFFFFFF
+    a &= M; b &= M; c &= M; d &= M  # noqa: E702
+    h = (_HASH_SEED ^ a ^ b ^ c ^ d) & M
+    x, y = 231232, 1232
+    a, b, h = _hashmix(a, b, h)
+    c, d, h = _hashmix(c, d, h)
+    a, x, h = _hashmix(a, x, h)
+    y, b, h = _hashmix(y, b, h)
+    c, x, h = _hashmix(c, x, h)
+    y, d, h = _hashmix(y, d, h)
+    return h
 
 
 @dataclass
@@ -93,6 +215,10 @@ class CrushMap:
         self.tunables = tunables or Tunables()
         self.max_devices = 0
         self.names: dict[int, str] = {}  # item id -> name (buckets+devices)
+        #: named alternate weight sets (crush_choose_arg_map role):
+        #: {key: {bucket_id: (weight_set 16.16 list, ids list | None)}}
+        self.choose_args: dict = {}
+        self._active_choose_args: dict | None = None
 
     # ----------------------------------------------------------- building
 
@@ -108,10 +234,22 @@ class CrushMap:
     def add_bucket(self, bucket: Bucket) -> None:
         if bucket.id >= 0:
             raise ValueError("bucket ids are negative")
-        if bucket.alg not in (ALG_STRAW2, ALG_UNIFORM):
+        if bucket.alg not in (ALG_STRAW2, ALG_UNIFORM, ALG_LIST,
+                              ALG_TREE, ALG_STRAW):
             raise ValueError(f"unsupported bucket alg {bucket.alg!r}")
         if len(bucket.items) != len(bucket.weights):
             raise ValueError("items/weights length mismatch")
+        # derived builder state per alg (builder.c make_*_bucket roles)
+        if bucket.alg == ALG_STRAW and not bucket.straws:
+            bucket.straws = calc_straw_scalers(bucket.weights)
+        if bucket.alg == ALG_LIST and not bucket.sum_weights:
+            acc = 0
+            bucket.sum_weights = []
+            for wgt in bucket.weights:
+                acc += wgt
+                bucket.sum_weights.append(acc)
+        if bucket.alg == ALG_TREE and not bucket.node_weights:
+            bucket.node_weights = calc_tree_nodes(bucket.weights)
         self.buckets[bucket.id] = bucket
         if bucket.name:
             self.names[bucket.id] = bucket.name
@@ -129,17 +267,74 @@ class CrushMap:
 
     def bucket_choose(self, b: Bucket, x: int, r: int) -> int:
         if b.alg == ALG_STRAW2:
-            return int(
-                native.straw2_choose(
-                    np.asarray(b.items, dtype=np.int32),
-                    np.asarray(b.weights, dtype=np.uint32),
-                    x,
-                    r,
+            arg = self._active_choose_args.get(b.id) \
+                if self._active_choose_args else None
+            if arg is None:
+                return int(
+                    native.straw2_choose(
+                        np.asarray(b.items, dtype=np.int32),
+                        np.asarray(b.weights, dtype=np.uint32),
+                        x,
+                        r,
+                    )
                 )
-            )
+            # crush_choose_arg role: alternate weight_set (balancer
+            # output) and optional substitute ids for hashing
+            weights, ids = arg
+            items_for_hash = ids if ids is not None else b.items
+            high = 0
+            high_draw = None
+            for i in range(b.size):
+                draw = int(native.straw2_draw(x, items_for_hash[i], r,
+                                              weights[i]))
+                if high_draw is None or draw > high_draw:
+                    high, high_draw = i, draw
+            return b.items[high]
         if b.alg == ALG_UNIFORM:
             return self._uniform_choose(b, x, r)
+        if b.alg == ALG_LIST:
+            return self._list_choose(b, x, r)
+        if b.alg == ALG_TREE:
+            return self._tree_choose(b, x, r)
+        if b.alg == ALG_STRAW:
+            return self._straw1_choose(b, x, r)
         raise ValueError(f"unsupported alg {b.alg}")
+
+    def _list_choose(self, b: Bucket, x: int, r: int) -> int:
+        """bucket_list_choose (mapper.c): walk items tail-first; accept
+        item i when its scaled hash falls inside its own weight slice
+        of the cumulative sum."""
+        for i in range(b.size - 1, -1, -1):
+            w = crush_hash32_4(x, b.items[i] & 0xFFFFFFFF, r,
+                               b.id & 0xFFFFFFFF) & 0xFFFF
+            w = (w * b.sum_weights[i]) >> 16
+            if w < b.weights[i]:
+                return b.items[i]
+        return b.items[0]
+
+    def _tree_choose(self, b: Bucket, x: int, r: int) -> int:
+        """bucket_tree_choose: descend the weight-balanced binary tree
+        by hashed splits."""
+        n = len(b.node_weights) >> 1
+        while not (n & 1):  # terminal nodes are odd
+            w = b.node_weights[n]
+            t = (crush_hash32_4(x, n, r, b.id & 0xFFFFFFFF) * w) >> 32
+            left = n - (1 << (_tree_height(n) - 1))
+            n = left if t < b.node_weights[left] else \
+                n + (1 << (_tree_height(n) - 1))
+        return b.items[n >> 1]
+
+    def _straw1_choose(self, b: Bucket, x: int, r: int) -> int:
+        """bucket_straw_choose: 16-bit hash draw scaled by precomputed
+        straw lengths; first maximum wins."""
+        high = 0
+        high_draw = -1
+        for i in range(b.size):
+            draw = (native.crush_hash32_3(x, b.items[i] & 0xFFFFFFFF, r)
+                    & 0xFFFF) * b.straws[i]
+            if draw > high_draw:
+                high, high_draw = i, draw
+        return b.items[high]
 
     def _uniform_choose(self, b: Bucket, x: int, r: int) -> int:
         """bucket_perm_choose, computed statelessly: build the Fisher-
@@ -390,12 +585,31 @@ class CrushMap:
         x: int,
         numrep: int,
         weights: np.ndarray | None = None,
+        choose_args=None,
     ) -> list[int]:
         """Port of crush_do_rule (mapper.c:878-1083). ``numrep`` is
         result_max (what CrushWrapper::do_rule passes); ``weights`` the
-        16.16 per-device out-weight vector (defaults to all-in)."""
+        16.16 per-device out-weight vector (defaults to all-in).
+        ``choose_args`` selects a named alternate weight set
+        (CrushWrapper::do_rule's choose_args_map role) or passes one
+        directly as {bucket_id: (weight_set, ids|None)}."""
         if weights is None:
             weights = np.full(self.max_devices, 0x10000, dtype=np.uint32)
+        if isinstance(choose_args, (str, int)):
+            choose_args = self.choose_args[choose_args]
+        self._active_choose_args = choose_args
+        try:
+            return self._do_rule_inner(ruleno, x, numrep, weights)
+        finally:
+            self._active_choose_args = None
+
+    def _do_rule_inner(
+        self,
+        ruleno: int,
+        x: int,
+        numrep: int,
+        weights: np.ndarray,
+    ) -> list[int]:
         t = self.tunables
         rule = self.rules[ruleno]
         result: list[int] = []

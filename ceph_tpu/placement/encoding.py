@@ -12,7 +12,7 @@ from ..utils import denc
 from . import crushmap as cm
 from .osdmap import Incremental, OSDMap, OSDState, Pool
 
-_V = 1
+_V = 2  # v2: choose_args + device classes
 
 
 # ----------------------------------------------------------------- crush
@@ -54,6 +54,23 @@ def encode_crushmap(m: cm.CrushMap) -> bytes:
     )
     out.append(denc.enc_u32(m.max_devices))
     out.append(denc.enc_map(m.names, denc.enc_i32, denc.enc_str))
+    # choose_args weight sets (balancer output must survive the wire)
+    out.append(denc.enc_u32(len(m.choose_args)))
+    for key in sorted(m.choose_args, key=str):
+        out.append(denc.enc_str(str(key)))
+        per_bucket = m.choose_args[key]
+        out.append(denc.enc_u32(len(per_bucket)))
+        for bid in sorted(per_bucket):
+            ws, ids = per_bucket[bid]
+            out.append(denc.enc_i32(bid))
+            out.append(denc.enc_list(ws, denc.enc_u32))
+            out.append(denc.enc_u8(ids is not None))
+            if ids is not None:
+                out.append(denc.enc_list(ids, denc.enc_i32))
+    out.append(
+        denc.enc_map(getattr(m, "device_classes", {}), denc.enc_i32,
+                     denc.enc_str)
+    )
     return b"".join(out)
 
 
@@ -94,6 +111,23 @@ def decode_crushmap(buf: bytes, off: int = 0) -> tuple[cm.CrushMap, int]:
     m.tunables = cm.Tunables(*vals)
     m.max_devices, off = denc.dec_u32(buf, off)
     m.names, off = denc.dec_map(buf, off, denc.dec_i32, denc.dec_str)
+    nca, off = denc.dec_u32(buf, off)
+    for _ in range(nca):
+        key, off = denc.dec_str(buf, off)
+        nbk, off = denc.dec_u32(buf, off)
+        per_bucket = {}
+        for _ in range(nbk):
+            bid, off = denc.dec_i32(buf, off)
+            ws, off = denc.dec_list(buf, off, denc.dec_u32)
+            has_ids, off = denc.dec_u8(buf, off)
+            ids = None
+            if has_ids:
+                ids, off = denc.dec_list(buf, off, denc.dec_i32)
+            per_bucket[bid] = (ws, ids)
+        m.choose_args[key] = per_bucket
+    m.device_classes, off = denc.dec_map(
+        buf, off, denc.dec_i32, denc.dec_str
+    )
     return m, off
 
 

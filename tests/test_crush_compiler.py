@@ -1,0 +1,193 @@
+"""Crushmap text compiler, CrushTester, legacy bucket algs in full
+rules, and choose_args tests (CrushCompiler.cc / CrushTester.cc roles).
+"""
+import numpy as np
+import pytest
+
+from ceph_tpu.placement import compiler, crushmap as cm
+from ceph_tpu.placement.tester import test_rule as run_rule_test
+
+SAMPLE = """
+# sample map
+tunable choose_total_tries 50
+device 0 osd.0
+device 1 osd.1
+device 2 osd.2 class ssd
+device 3 osd.3
+device 4 osd.4
+device 5 osd.5
+
+type 0 osd
+type 1 host
+type 2 root
+
+host host0 {
+    id -2
+    alg straw2
+    hash 0
+    item osd.0 weight 1.000
+    item osd.1 weight 1.000
+}
+host host1 {
+    id -3
+    alg straw2
+    hash 0
+    item osd.2 weight 2.000
+    item osd.3 weight 1.000
+}
+host host2 {
+    id -4
+    alg straw2
+    hash 0
+    item osd.4 weight 1.000
+    item osd.5 weight 1.000
+}
+root default {
+    id -1
+    alg straw2
+    hash 0
+    item host0 weight 2.000
+    item host1 weight 3.000
+    item host2 weight 2.000
+}
+
+rule replicated_rule {
+    id 0
+    type replicated
+    step take default
+    step chooseleaf firstn 0 type host
+    step emit
+}
+rule ec_rule {
+    id 1
+    type replicated
+    step set_chooseleaf_tries 5
+    step take default
+    step chooseleaf indep 0 type host
+    step emit
+}
+"""
+
+
+def test_compile_sample():
+    m = compiler.compile(SAMPLE)
+    assert m.max_devices == 6
+    assert set(m.buckets) == {-1, -2, -3, -4}
+    assert m.buckets[-1].items == [-2, -3, -4]
+    assert m.buckets[-3].weights == [0x20000, 0x10000]
+    assert m.tunables.choose_total_tries == 50
+    assert m.device_classes == {2: "ssd"}
+    assert len(m.rules[0].steps) == 3
+    assert m.rules[1].steps[0].op == cm.OP_SET_CHOOSELEAF_TRIES
+    # the compiled map actually places
+    out = m.do_rule(0, 1234, 3)
+    assert len({d for d in out if d >= 0}) == 3
+
+
+def test_compile_decompile_roundtrip():
+    m1 = compiler.compile(SAMPLE)
+    text = compiler.decompile(m1)
+    m2 = compiler.compile(text)
+    # placement-equivalent: identical mappings across rules and inputs
+    for rule in (0, 1):
+        for x in range(200):
+            assert m1.do_rule(rule, x, 3) == m2.do_rule(rule, x, 3), \
+                (rule, x)
+
+
+def test_compile_errors():
+    with pytest.raises(compiler.CompileError):
+        compiler.compile("garbage line here")
+    with pytest.raises(compiler.CompileError):
+        compiler.compile("tunable nonexistent 5")
+    with pytest.raises(compiler.CompileError):
+        compiler.compile(
+            "type 1 host\nhost h {\n id -1\n alg warp\n}\n"
+        )
+    with pytest.raises(compiler.CompileError):
+        compiler.compile("type 1 host\nhost h {\n alg straw2\n}\n")
+
+
+def test_legacy_algs_in_full_rule():
+    """list/tree/straw buckets work through do_rule end-to-end."""
+    for alg in (cm.ALG_LIST, cm.ALG_TREE, cm.ALG_STRAW):
+        m = cm.CrushMap()
+        m.add_type(1, "root")
+        m.add_bucket(cm.Bucket(
+            id=-1, type_id=1, alg=alg, items=list(range(6)),
+            weights=[0x10000] * 6, name="root",
+        ))
+        m.add_rule(cm.flat_firstn_rule(0))
+        seen = set()
+        for x in range(300):
+            out = m.do_rule(0, x, 3)
+            picked = [d for d in out if d >= 0]
+            assert len(set(picked)) == len(picked), (alg, x)
+            seen.update(picked)
+        assert seen == set(range(6)), alg
+
+
+def test_choose_args_reweights_placement():
+    """A choose_args weight set shifts straw2 placement away from a
+    zero-weighted item without touching the base map (upmap-balancer
+    mechanics, crush_choose_arg role)."""
+    m = cm.build_flat(4)
+    m.add_rule(cm.flat_firstn_rule(0))
+    base = [m.do_rule(0, x, 2) for x in range(400)]
+    m.choose_args["balancer"] = {-1: ([0, 0x10000, 0x10000, 0x10000],
+                                      None)}
+    shifted = [m.do_rule(0, x, 2, choose_args="balancer")
+               for x in range(400)]
+    assert any(0 in row for row in base)
+    assert not any(0 in row for row in shifted)
+    # base behavior untouched afterwards
+    assert [m.do_rule(0, x, 2) for x in range(400)] == base
+
+
+def test_choose_args_substitute_ids():
+    m = cm.build_flat(4)
+    m.add_rule(cm.flat_firstn_rule(0))
+    base = [m.do_rule(0, x, 2) for x in range(100)]
+    # same weights but different hash ids -> different placements
+    m.choose_args[0] = {-1: ([0x10000] * 4, [100, 101, 102, 103])}
+    swapped = [m.do_rule(0, x, 2, choose_args=0) for x in range(100)]
+    assert base != swapped
+
+
+# -------------------------------------------------------------- tester
+
+
+def test_tester_uniform_distribution():
+    m = cm.build_flat(8)
+    m.add_rule(cm.flat_firstn_rule(0))
+    rep = run_rule_test(m, 0, 3, n_inputs=3000)
+    assert rep.placed == 3000 * 3
+    assert not rep.bad_mappings
+    assert rep.max_deviation(m) < 0.02  # uniform weights -> ~1/8 each
+
+
+def test_tester_weighted_distribution():
+    m = cm.build_flat(4, osd_weights=[4.0, 1.0, 1.0, 1.0])
+    m.add_rule(cm.flat_firstn_rule(0))
+    rep = run_rule_test(m, 0, 1, n_inputs=6000)
+    util = rep.utilization()
+    exp = rep.expected_utilization(m)
+    assert abs(exp[0] - 4 / 7) < 1e-9
+    assert abs(util[0] - exp[0]) < 0.03
+
+
+def test_tester_detects_bad_mappings():
+    # ask for more replicas than devices exist
+    m = cm.build_flat(2)
+    m.add_rule(cm.flat_firstn_rule(0))
+    rep = run_rule_test(m, 0, 3, n_inputs=50)
+    assert len(rep.bad_mappings) == 50
+
+
+def test_tester_device_engine_matches_host():
+    m = cm.build_hierarchy(osds_per_host=2, n_hosts=4)
+    m.add_rule(cm.replicated_rule(0, failure_domain_type=1))
+    host = run_rule_test(m, 0, 3, n_inputs=256, device=False)
+    dev = run_rule_test(m, 0, 3, n_inputs=256, device=True)
+    assert host.device_counts == dev.device_counts
+    assert host.bad_mappings == dev.bad_mappings

@@ -33,12 +33,33 @@ def refcrush(tmp_path_factory):
         "{return generate_exponential_distribution(0,x,id,r,w);}\n"
         "unsigned ref_hash3(unsigned a,unsigned b,unsigned c)"
         "{return crush_hash32_3(0,a,b,c);}\n"
+        "unsigned ref_hash4(unsigned a,unsigned b,unsigned c,unsigned d)"
+        "{return crush_hash32_4(0,a,b,c,d);}\n"
+        "#include <crush/builder.h>\n"
+        "int ref_list_choose(int n,int*items,int*weights,int x,int r){\n"
+        "  struct crush_bucket_list *b=crush_make_list_bucket("
+        "CRUSH_HASH_RJENKINS1,1,n,items,weights); b->h.id=-1;\n"
+        "  return bucket_list_choose(b,x,r);}\n"
+        "int ref_tree_choose(int n,int*items,int*weights,int x,int r){\n"
+        "  struct crush_bucket_tree *b=crush_make_tree_bucket("
+        "CRUSH_HASH_RJENKINS1,1,n,items,weights); b->h.id=-1;\n"
+        "  return bucket_tree_choose(b,x,r);}\n"
+        "int ref_straw_choose(int n,int*items,int*weights,int x,int r){\n"
+        "  struct crush_map *m=crush_create(); m->straw_calc_version=1;\n"
+        "  struct crush_bucket_straw *b=crush_make_straw_bucket(m,"
+        "CRUSH_HASH_RJENKINS1,1,n,items,weights); b->h.id=-1;\n"
+        "  int out=bucket_straw_choose(b,x,r); crush_destroy(m); return out;}\n"
+        "int ref_straw_scaler(int n,int*items,int*weights,int i){\n"
+        "  struct crush_map *m=crush_create(); m->straw_calc_version=1;\n"
+        "  struct crush_bucket_straw *b=crush_make_straw_bucket(m,"
+        "CRUSH_HASH_RJENKINS1,1,n,items,weights);\n"
+        "  int out=b->straws[i]; crush_destroy(m); return out;}\n"
     )
     so = d / "refcrush.so"
     subprocess.run(
         ["gcc", "-O2", "-shared", "-fPIC", f"-I{d}", f"-I{REF}",
          "-I/root/reference/src", "-o", str(so), str(d / "harness.c"),
-         f"{REF}/hash.c"],
+         f"{REF}/hash.c", f"{REF}/builder.c", f"{REF}/crush.c", "-lm"],
         check=True, capture_output=True, cwd=REF,
     )
     lib = ctypes.CDLL(str(so))
@@ -48,7 +69,20 @@ def refcrush(tmp_path_factory):
     lib.ref_draw.argtypes = [ctypes.c_int] * 3 + [ctypes.c_uint32]
     lib.ref_hash3.restype = ctypes.c_uint32
     lib.ref_hash3.argtypes = [ctypes.c_uint32] * 3
+    lib.ref_hash4.restype = ctypes.c_uint32
+    lib.ref_hash4.argtypes = [ctypes.c_uint32] * 4
+    iptr = ctypes.POINTER(ctypes.c_int)
+    for fn in ("ref_list_choose", "ref_tree_choose", "ref_straw_choose"):
+        f = getattr(lib, fn)
+        f.restype = ctypes.c_int
+        f.argtypes = [ctypes.c_int, iptr, iptr, ctypes.c_int, ctypes.c_int]
+    lib.ref_straw_scaler.restype = ctypes.c_int
+    lib.ref_straw_scaler.argtypes = [ctypes.c_int, iptr, iptr, ctypes.c_int]
     return lib
+
+
+def _carr(vals):
+    return (ctypes.c_int * len(vals))(*vals)
 
 
 def test_crush_ln_full_domain(refcrush):
@@ -75,3 +109,63 @@ def test_straw2_draw_parity(refcrush):
         x, idv, r = (int(v) for v in rng.integers(0, 2**31, 3))
         w = int(rng.integers(1, 2**20))
         assert refcrush.ref_draw(x, idv, r, w) == nt.straw2_draw(x, idv, r, w)
+
+
+def test_hash4_parity(refcrush):
+    from ceph_tpu.placement.crushmap import crush_hash32_4
+
+    rng = np.random.default_rng(2)
+    for _ in range(5000):
+        a, b, c, d = (int(v) for v in rng.integers(0, 2**32, 4))
+        assert refcrush.ref_hash4(a, b, c, d) == crush_hash32_4(a, b, c, d)
+
+
+def _rand_bucket(rng, alg):
+    from ceph_tpu.placement.crushmap import Bucket
+
+    n = int(rng.integers(2, 12))
+    items = list(range(n))
+    weights = [int(w) for w in rng.integers(1, 0x40000, n)]
+    return Bucket(id=-1, type_id=1, alg=alg, items=items,
+                  weights=weights), items, weights
+
+
+@pytest.mark.parametrize("alg,ref_fn", [
+    ("list", "ref_list_choose"),
+    ("tree", "ref_tree_choose"),
+    ("straw", "ref_straw_choose"),
+])
+def test_legacy_bucket_choose_parity(refcrush, alg, ref_fn):
+    """The pre-straw2 bucket algorithms must match the reference's own
+    builder + mapper bit-for-bit (mapper.c bucket_*_choose)."""
+    from ceph_tpu.placement.crushmap import CrushMap
+
+    rng = np.random.default_rng(hash(alg) % 2**31)
+    m = CrushMap()
+    ref = getattr(refcrush, ref_fn)
+    for _ in range(8):
+        b, items, weights = _rand_bucket(rng, alg)
+        m.add_bucket(b)
+        for _ in range(200):
+            x = int(rng.integers(0, 2**31))
+            r = int(rng.integers(0, 8))
+            want = ref(len(items), _carr(items), _carr(weights), x, r)
+            got = m.bucket_choose(b, x, r)
+            assert got == want, f"{alg} x={x} r={r} w={weights}"
+
+
+def test_straw_scaler_parity(refcrush):
+    """crush_calc_straw v1 scalers match builder.c exactly."""
+    from ceph_tpu.placement.crushmap import calc_straw_scalers
+
+    rng = np.random.default_rng(55)
+    for _ in range(30):
+        n = int(rng.integers(1, 10))
+        items = list(range(n))
+        weights = [int(w) for w in rng.integers(0, 0x30000, n)]
+        ours = calc_straw_scalers(weights)
+        for i in range(n):
+            want = refcrush.ref_straw_scaler(
+                n, _carr(items), _carr(weights), i
+            )
+            assert ours[i] == want, f"weights={weights} i={i}"
