@@ -129,14 +129,12 @@ class RadosClient:
         except Exception:
             pass  # wait for a map change to resend
 
-    async def _submit(self, pool_id: int, name: str | bytes, opname: str,
-                      data: bytes = b"", offset: int = 0,
-                      length: int = -1) -> M.MOSDOpReply:
+    async def _submit(self, pool_id: int, name: str | bytes,
+                      ops: list[tuple]) -> M.MOSDOpReply:
         oid = name.encode() if isinstance(name, str) else bytes(name)
         pgid = self.osdmap.object_to_pg(pool_id, oid)
         self._tid += 1
-        msg = M.MOSDOp(tid=self._tid, pgid=pgid, oid=oid, op=opname,
-                       offset=offset, length=length, data=data,
+        msg = M.MOSDOp(tid=self._tid, pgid=pgid, oid=oid, ops=ops,
                        epoch=self.osdmap.epoch)
         op = _InFlight(msg=msg, fut=asyncio.get_running_loop()
                        .create_future())
@@ -148,8 +146,15 @@ class RadosClient:
         if reply.result != M.OK:
             if reply.result == M.ENOENT:
                 raise KeyError(name)
-            raise IOError(f"{opname} failed: {reply.result}")
+            raise IOError(f"op vector failed: {reply.result}")
         return reply
+
+    async def operate(self, pool_id: int, name,
+                      op: "ObjectOperation") -> list[bytes]:
+        """Execute a compound ObjectOperation atomically on one object
+        (IoCtxImpl::operate role); returns each op's output bytes."""
+        reply = await self._submit(pool_id, name, op.ops)
+        return [d for _r, d in reply.outs]
 
     # ------------------------------------------------------------ surface
 
@@ -163,17 +168,161 @@ class RadosClient:
         return self._pools.get("_last", pool.id)
 
     async def write_full(self, pool_id: int, name, data: bytes) -> None:
-        await self._submit(pool_id, name, "writefull", data=bytes(data))
+        await self._submit(pool_id, name,
+                           [M.osd_op("writefull", data=bytes(data))])
+
+    async def write(self, pool_id: int, name, offset: int,
+                    data: bytes) -> None:
+        await self._submit(
+            pool_id, name,
+            [M.osd_op("write", offset=offset, data=bytes(data))],
+        )
+
+    async def append(self, pool_id: int, name, data: bytes) -> None:
+        await self._submit(pool_id, name,
+                           [M.osd_op("append", data=bytes(data))])
+
+    async def truncate(self, pool_id: int, name, size: int) -> None:
+        await self._submit(pool_id, name,
+                           [M.osd_op("truncate", offset=size)])
+
+    async def zero(self, pool_id: int, name, offset: int,
+                   length: int) -> None:
+        await self._submit(
+            pool_id, name,
+            [M.osd_op("zero", offset=offset, length=length)],
+        )
 
     async def read(self, pool_id: int, name, offset: int = 0,
                    length: int = -1) -> bytes:
-        reply = await self._submit(pool_id, name, "read", offset=offset,
-                                   length=length)
-        return reply.data
+        reply = await self._submit(
+            pool_id, name,
+            [M.osd_op("read", offset=offset, length=length)],
+        )
+        return reply.outs[0][1]
 
     async def stat(self, pool_id: int, name) -> int:
-        reply = await self._submit(pool_id, name, "stat")
-        return reply.size
+        reply = await self._submit(pool_id, name, [M.osd_op("stat")])
+        from ..utils import denc
+
+        return denc.dec_u64(reply.outs[0][1], 0)[0]
 
     async def delete(self, pool_id: int, name) -> None:
-        await self._submit(pool_id, name, "delete")
+        await self._submit(pool_id, name, [M.osd_op("delete")])
+
+    async def getxattr(self, pool_id: int, name, key: str) -> bytes:
+        reply = await self._submit(
+            pool_id, name, [M.osd_op("getxattr", key=key.encode())]
+        )
+        return reply.outs[0][1]
+
+    async def setxattr(self, pool_id: int, name, key: str,
+                       value: bytes) -> None:
+        await self._submit(
+            pool_id, name,
+            [M.osd_op("setxattr", key=key.encode(), data=bytes(value))],
+        )
+
+    async def rmxattr(self, pool_id: int, name, key: str) -> None:
+        await self._submit(pool_id, name,
+                           [M.osd_op("rmxattr", key=key.encode())])
+
+    async def getxattrs(self, pool_id: int, name) -> dict[str, bytes]:
+        from ..utils import denc
+
+        reply = await self._submit(pool_id, name,
+                                   [M.osd_op("getxattrs")])
+        return denc.dec_map(reply.outs[0][1], 0, denc.dec_str,
+                            denc.dec_bytes)[0]
+
+    async def omap_set(self, pool_id: int, name,
+                       kv: dict[bytes, bytes]) -> None:
+        await self._submit(pool_id, name,
+                           [M.osd_op("omap_setkeys", kv=kv)])
+
+    async def omap_get(self, pool_id: int, name) -> dict[bytes, bytes]:
+        from ..utils import denc
+
+        reply = await self._submit(pool_id, name, [M.osd_op("omap_get")])
+        return denc.dec_map(reply.outs[0][1], 0, denc.dec_bytes,
+                            denc.dec_bytes)[0]
+
+    async def omap_rm(self, pool_id: int, name, keys) -> None:
+        await self._submit(
+            pool_id, name,
+            [M.osd_op("omap_rmkeys", keys=[bytes(k) for k in keys])],
+        )
+
+
+class ObjectOperation:
+    """Compound-op builder (ObjectWriteOperation/ObjectReadOperation
+    role, src/include/rados/librados.hpp): chain ops, execute with
+    RadosClient.operate — all-or-nothing on one object."""
+
+    def __init__(self) -> None:
+        self.ops: list[tuple] = []
+
+    def _add(self, *a, **kw) -> "ObjectOperation":
+        self.ops.append(M.osd_op(*a, **kw))
+        return self
+
+    def create(self, exclusive: bool = True):
+        return self._add("create", length=0 if exclusive else 1)
+
+    def write_full(self, data: bytes):
+        return self._add("writefull", data=bytes(data))
+
+    def write(self, offset: int, data: bytes):
+        return self._add("write", offset=offset, data=bytes(data))
+
+    def append(self, data: bytes):
+        return self._add("append", data=bytes(data))
+
+    def truncate(self, size: int):
+        return self._add("truncate", offset=size)
+
+    def zero(self, offset: int, length: int):
+        return self._add("zero", offset=offset, length=length)
+
+    def remove(self):
+        return self._add("delete")
+
+    def setxattr(self, key: str, value: bytes):
+        return self._add("setxattr", key=key.encode(),
+                         data=bytes(value))
+
+    def rmxattr(self, key: str):
+        return self._add("rmxattr", key=key.encode())
+
+    def omap_set(self, kv: dict[bytes, bytes]):
+        return self._add("omap_setkeys", kv=kv)
+
+    def omap_rm_keys(self, keys):
+        return self._add("omap_rmkeys", keys=[bytes(k) for k in keys])
+
+    def omap_set_header(self, header: bytes):
+        return self._add("omap_setheader", data=bytes(header))
+
+    def omap_clear(self):
+        return self._add("omap_clear")
+
+    def read(self, offset: int = 0, length: int = -1):
+        return self._add("read", offset=offset, length=length)
+
+    def stat(self):
+        return self._add("stat")
+
+    def getxattr(self, key: str):
+        return self._add("getxattr", key=key.encode())
+
+    def getxattrs(self):
+        return self._add("getxattrs")
+
+    def omap_get(self):
+        return self._add("omap_get")
+
+    def omap_get_header(self):
+        return self._add("omap_getheader")
+
+    def omap_get_keys(self):
+        return self._add("omap_getkeys")

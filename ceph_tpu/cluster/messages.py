@@ -78,17 +78,82 @@ class MPoolCreateReply(Message):
 # ---------------------------------------------------------- client <-> osd
 
 
+def _enc_osd_op(e):
+    """One op of the vector (the reference's OSDOp / ceph_osd_op role):
+    (op name, offset, length, key, data, kv-map, key-list)."""
+    from ..utils import denc
+
+    op, offset, length, key, data, kv, keys = e
+    return (denc.enc_str(op) + denc.enc_u64(offset)
+            + denc.enc_i64(length) + denc.enc_bytes(key)
+            + denc.enc_bytes(data)
+            + denc.enc_map(kv, denc.enc_bytes, denc.enc_bytes)
+            + denc.enc_list(keys, denc.enc_bytes))
+
+
+def _dec_osd_op(buf, off):
+    from ..utils import denc
+
+    op, off = denc.dec_str(buf, off)
+    offset, off = denc.dec_u64(buf, off)
+    length, off = denc.dec_i64(buf, off)
+    key, off = denc.dec_bytes(buf, off)
+    data, off = denc.dec_bytes(buf, off)
+    kv, off = denc.dec_map(buf, off, denc.dec_bytes, denc.dec_bytes)
+    keys, off = denc.dec_list(buf, off, denc.dec_bytes)
+    return (op, offset, length, key, data, kv, keys), off
+
+
+def _enc_osd_ops(v):
+    from ..utils import denc
+
+    return denc.enc_list(v, _enc_osd_op)
+
+
+def _dec_osd_ops(buf, off):
+    from ..utils import denc
+
+    return denc.dec_list(buf, off, _dec_osd_op)
+
+
+def osd_op(op: str, offset: int = 0, length: int = -1, key: bytes = b"",
+           data: bytes = b"", kv: dict | None = None,
+           keys: list | None = None) -> tuple:
+    return (op, offset, length, bytes(key), bytes(data),
+            dict(kv or {}), list(keys or []))
+
+
+def _enc_outs(v):
+    """Per-op results: (result i32, data bytes)."""
+    from ..utils import denc
+
+    return denc.enc_list(
+        v, lambda e: denc.enc_i32(e[0]) + denc.enc_bytes(e[1])
+    )
+
+
+def _dec_outs(buf, off):
+    from ..utils import denc
+
+    def one(b, o):
+        r, o = denc.dec_i32(b, o)
+        d, o = denc.dec_bytes(b, o)
+        return (r, d), o
+
+    return denc.dec_list(buf, off, one)
+
+
 @register_message
 class MOSDOp(Message):
     TYPE = 20
+    # ops: the op vector (MOSDOp.h vector<OSDOp> role) applied
+    # atomically to one object; reads inside the vector observe the
+    # effects of earlier ops in the same vector
     FIELDS = (
         ("tid", "u64"),
         ("pgid", PGID),
         ("oid", "bytes"),
-        ("op", "str"),  # writefull | read | delete | stat
-        ("offset", "u64"),
-        ("length", "i64"),  # -1 = to end (read)
-        ("data", "bytes"),
+        ("ops", (_enc_osd_ops, _dec_osd_ops)),
         ("epoch", "u32"),  # client's map epoch at send time
     )
 
@@ -96,11 +161,14 @@ class MOSDOp(Message):
 @register_message
 class MOSDOpReply(Message):
     TYPE = 21
+    # data/size mirror the first read-class op's output (fast path);
+    # outs carries every op's (result, data)
     FIELDS = (
         ("tid", "u64"),
         ("result", "i32"),
         ("data", "bytes"),
         ("size", "u64"),
+        ("outs", (_enc_outs, _dec_outs)),
         ("epoch", "u32"),  # responder's epoch (client refreshes on ESTALE)
     )
 
@@ -171,6 +239,7 @@ class MECSubReadReply(Message):
         ("data", "bytes"),
         ("digest", "u32"),  # stored hinfo crc for the returned chunk
         ("size", "u64"),  # stored whole-object size attr
+        ("attrs", "map:str:bytes"),  # user xattrs (mirrored per shard)
     )
 
 

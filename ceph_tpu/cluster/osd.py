@@ -34,6 +34,11 @@ from .scheduler import CLIENT, RECOVERY, SCRUB, MClockScheduler, Throttle
 _FAILED = object()
 
 
+def _op_bytes(msg) -> int:
+    """Payload bytes of an op vector (throttle accounting)."""
+    return sum(len(o[4]) for o in msg.ops)
+
+
 class ECBatcher:
     """Collects EC stripes for one reactor tick, encodes them as one
     device batch per (codec profile, chunk words) bucket."""
@@ -332,7 +337,7 @@ class OSDLite:
             # enqueue_op role: client ops take the mClock queue under
             # the ingest byte throttle; sub-ops and control traffic stay
             # fast-dispatch
-            await self.throttle.acquire(len(msg.data))
+            await self.throttle.acquire(_op_bytes(msg))
             self.op_scheduler.enqueue(
                 CLIENT, lambda src=src, msg=msg: self._client_op(src, msg)
             )
@@ -402,12 +407,12 @@ class OSDLite:
                 await self.send(
                     src,
                     M.MOSDOpReply(tid=msg.tid, result=M.ESTALE, data=b"",
-                                  size=0, epoch=self.epoch),
+                                  size=0, outs=[], epoch=self.epoch),
                 )
                 return
             await pg.do_op(src, msg)
         finally:
-            self.throttle.release(len(msg.data))
+            self.throttle.release(_op_bytes(msg))
 
     def _my_shard(self, pgid, msg_shard: int) -> int:
         """The shard *this* OSD holds for pgid (push messages carry the

@@ -47,6 +47,52 @@ META_OID = b"_pgmeta"
 ATTR_V = "v"
 ATTR_SIZE = "size"
 ATTR_HINFO = "hinfo"
+USER_ATTR = "u:"  # user xattr namespace within store attrs
+OMAP_HDR = "_oh"
+
+#: op-vector verbs that mutate (the CEPH_OSD_OP write-class role)
+WRITE_OPS = frozenset((
+    "writefull", "write", "append", "zero", "truncate", "delete",
+    "create", "setxattr", "rmxattr", "omap_setkeys", "omap_rmkeys",
+    "omap_setheader", "omap_clear",
+))
+EOPNOTSUPP = -95
+EEXIST = -17
+ENODATA = -61  # missing xattr (the reference's getxattr errno)
+
+
+class OpError(Exception):
+    """Aborts the whole op vector with an errno-style code (a failing
+    op fails the transaction, PrimaryLogPG::do_osd_ops contract)."""
+
+    def __init__(self, code: int, what: str = ""):
+        super().__init__(what or str(code))
+        self.code = code
+
+
+def _object_mutation(t: tx.Transaction, cid: str, oid: bytes,
+                     payload: bytes | None, version,
+                     attrs: dict[str, bytes], state: dict | None,
+                     existed: bool) -> None:
+    """Shared shape of one object mutation: full-state replace (data +
+    internal attrs + user xattrs + omap) or removal."""
+    if payload is None:
+        if existed:
+            t.remove(cid, oid)
+        return
+    t.truncate(cid, oid, 0)
+    t.write(cid, oid, 0, payload)
+    full_attrs = {ATTR_V: enc_ver(version), **attrs}
+    if state is not None:
+        t.rmattrs(cid, oid)
+        for k, v in state["xattrs"].items():
+            full_attrs[USER_ATTR + k] = v
+        t.omap_clear(cid, oid)
+        if state["omap"]:
+            t.omap_setkeys(cid, oid, state["omap"])
+        if state["omap_header"]:
+            t.omap_setheader(cid, oid, state["omap_header"])
+    t.setattrs(cid, oid, full_attrs)
 
 
 def enc_ver(v: tuple[int, int]) -> bytes:
@@ -162,7 +208,7 @@ class PG:
                     src,
                     M.MOSDOpReply(
                         tid=m.tid, result=M.ESTALE, data=b"", size=0,
-                        epoch=self.osd.osdmap.epoch,
+                        outs=[], epoch=self.osd.osdmap.epoch,
                     ),
                 )
             )
@@ -174,7 +220,7 @@ class PG:
             await self.osd.send(
                 src,
                 M.MOSDOpReply(tid=m.tid, result=M.ESTALE, data=b"", size=0,
-                              epoch=self.osd.osdmap.epoch),
+                              outs=[], epoch=self.osd.osdmap.epoch),
             )
             return
         if self.state != "active":
@@ -182,63 +228,188 @@ class PG:
             return
         perf = self.osd.perf
         perf.inc("op")
-        perf.inc("op_w" if m.op in ("writefull", "delete") else "op_r")
+        write_class = any(o[0] in WRITE_OPS for o in m.ops)
+        perf.inc("op_w" if write_class else "op_r")
         t0 = time.perf_counter()
         try:
-            if m.op == "writefull":
+            if write_class:
                 async with self.lock:
-                    await self._op_writefull(m.oid, m.data)
-                reply = M.MOSDOpReply(tid=m.tid, result=M.OK, data=b"",
-                                      size=len(m.data),
-                                      epoch=self.osd.osdmap.epoch)
-            elif m.op == "delete":
-                async with self.lock:
-                    await self._op_delete(m.oid)
-                reply = M.MOSDOpReply(tid=m.tid, result=M.OK, data=b"",
-                                      size=0, epoch=self.osd.osdmap.epoch)
-            elif m.op in ("read", "stat"):
-                data, size = await self._op_read(m.oid)
-                if m.op == "stat":
-                    data = b""
-                elif m.length >= 0:
-                    data = data[m.offset : m.offset + m.length]
-                elif m.offset:
-                    data = data[m.offset :]
-                reply = M.MOSDOpReply(tid=m.tid, result=M.OK, data=data,
-                                      size=size,
-                                      epoch=self.osd.osdmap.epoch)
+                    outs, size = await self._execute_ops(m.oid, m.ops)
             else:
-                reply = M.MOSDOpReply(tid=m.tid, result=M.EAGAIN, data=b"",
-                                      size=0, epoch=self.osd.osdmap.epoch)
+                outs, size = await self._execute_ops(m.oid, m.ops)
+            first = next((d for r, d in outs if d), b"")
+            reply = M.MOSDOpReply(tid=m.tid, result=M.OK, data=first,
+                                  size=size, outs=outs,
+                                  epoch=self.osd.osdmap.epoch)
+        except OpError as e:
+            reply = M.MOSDOpReply(tid=m.tid, result=e.code, data=b"",
+                                  size=0, outs=[],
+                                  epoch=self.osd.osdmap.epoch)
         except (KeyError, NotFound):
             reply = M.MOSDOpReply(tid=m.tid, result=M.ENOENT, data=b"",
-                                  size=0, epoch=self.osd.osdmap.epoch)
+                                  size=0, outs=[],
+                                  epoch=self.osd.osdmap.epoch)
         except Exception:
-            self.osd.log_exc(f"pg {self.pgid} op {m.op}")
+            self.osd.log_exc(f"pg {self.pgid} op vector")
             reply = M.MOSDOpReply(tid=m.tid, result=M.EAGAIN, data=b"",
-                                  size=0, epoch=self.osd.osdmap.epoch)
+                                  size=0, outs=[],
+                                  epoch=self.osd.osdmap.epoch)
         perf.tinc("op_latency", time.perf_counter() - t0)
         await self.osd.send(src, reply)
 
-    # ------------------------------------------------------------- writes
+    # ------------------------------------------------- op-vector engine
 
-    async def _op_writefull(self, oid: bytes, data: bytes) -> None:
-        version = self.next_version()
-        prior = self._object_version(oid)
-        entry = Entry(OP_MODIFY, oid, version, prior)
-        if self.is_ec:
-            await self._write_ec(oid, data, entry)
-        else:
-            await self._write_replicated(oid, data, entry)
+    async def _execute_ops(self, oid: bytes, ops) -> tuple[list, int]:
+        """Apply the op vector against a working copy of the object
+        (do_osd_ops role): reads inside the vector see earlier writes,
+        mutations commit atomically at the end, any failure aborts the
+        whole vector. Returns ([(result, data)] per op, object size)."""
+        state = await self._load_object_state(oid)
+        exists0 = state is not None
+        if state is None:
+            state = {"data": bytearray(), "xattrs": {}, "omap": {},
+                     "omap_header": b""}
+        data = state["data"]
+        outs: list[tuple[int, bytes]] = []
+        mutated = False
+        deleted = False
+        for (op, offset, length, key, payload, kv, keys) in ops:
+            out = b""
+            if op in WRITE_OPS:
+                mutated = True
+            if op == "read":
+                if not exists0 and not mutated:
+                    raise OpError(M.ENOENT)
+                if length < 0:
+                    out = bytes(data[offset:])
+                else:
+                    out = bytes(data[offset : offset + length])
+            elif op == "stat":
+                if not exists0 and not mutated:
+                    raise OpError(M.ENOENT)
+                out = denc.enc_u64(len(data))
+            elif op == "getxattr":
+                k = key.decode()
+                if k not in state["xattrs"]:
+                    raise OpError(ENODATA, f"xattr {k}")
+                out = state["xattrs"][k]
+            elif op == "getxattrs":
+                out = denc.enc_map(state["xattrs"], denc.enc_str,
+                                   denc.enc_bytes)
+            elif op == "omap_get":
+                self._check_omap()
+                out = denc.enc_map(state["omap"], denc.enc_bytes,
+                                   denc.enc_bytes)
+            elif op == "omap_getheader":
+                self._check_omap()
+                out = state["omap_header"]
+            elif op == "omap_getkeys":
+                self._check_omap()
+                out = denc.enc_list(sorted(state["omap"]), denc.enc_bytes)
+            elif op == "writefull":
+                data[:] = payload
+                deleted = False
+            elif op == "write":
+                end = offset + len(payload)
+                if len(data) < end:
+                    data.extend(b"\0" * (end - len(data)))
+                data[offset:end] = payload
+            elif op == "append":
+                data.extend(payload)
+            elif op == "zero":
+                end = offset + length
+                if len(data) < end:
+                    data.extend(b"\0" * (end - len(data)))
+                data[offset:end] = b"\0" * length
+            elif op == "truncate":
+                size = offset
+                if size < len(data):
+                    del data[size:]
+                else:
+                    data.extend(b"\0" * (size - len(data)))
+            elif op == "create":
+                if exists0 and length == 0:  # length 0 = exclusive
+                    raise OpError(EEXIST)
+            elif op == "delete":
+                if not exists0 and not mutated:
+                    raise OpError(M.ENOENT)
+                deleted = True
+            elif op == "setxattr":
+                state["xattrs"][key.decode()] = payload
+            elif op == "rmxattr":
+                state["xattrs"].pop(key.decode(), None)
+            elif op == "omap_setkeys":
+                self._check_omap()
+                state["omap"].update(kv)
+            elif op == "omap_rmkeys":
+                self._check_omap()
+                for k in keys:
+                    state["omap"].pop(k, None)
+            elif op == "omap_setheader":
+                self._check_omap()
+                state["omap_header"] = payload
+            elif op == "omap_clear":
+                self._check_omap()
+                state["omap"].clear()
+                state["omap_header"] = b""
+            else:
+                raise OpError(EOPNOTSUPP, f"op {op!r}")
+            outs.append((M.OK, out))
+        if mutated:
+            version = self.next_version()
+            prior = self._object_version(oid)
+            if deleted:
+                entry = Entry(OP_DELETE, oid, version, prior)
+                if self.is_ec:
+                    await self._write_ec(oid, None, entry)
+                else:
+                    await self._write_replicated(oid, None, entry)
+            else:
+                entry = Entry(OP_MODIFY, oid, version, prior)
+                if self.is_ec:
+                    await self._write_ec(oid, bytes(data), entry,
+                                         state=state)
+                else:
+                    await self._write_replicated(oid, bytes(data), entry,
+                                                 state=state)
+        return outs, len(data) if not deleted else 0
 
-    async def _op_delete(self, oid: bytes) -> None:
-        version = self.next_version()
-        prior = self._object_version(oid)
-        entry = Entry(OP_DELETE, oid, version, prior)
+    def _check_omap(self) -> None:
         if self.is_ec:
-            await self._write_ec(oid, None, entry)
-        else:
-            await self._write_replicated(oid, None, entry)
+            # EC pools do not support omap (the reference restriction)
+            raise OpError(EOPNOTSUPP, "omap on EC pool")
+
+    async def _load_object_state(self, oid: bytes):
+        """Current object facets, or None when absent. Replicated reads
+        come from the primary's store; EC data reconstructs via
+        _read_ec, metadata from the primary's own shard."""
+        store = self.osd.store
+        if not self.is_ec:
+            try:
+                data = bytearray(store.read(self.cid, oid))
+            except NotFound:
+                return None
+            attrs = store.getattrs(self.cid, oid)
+            return {
+                "data": data,
+                "xattrs": {k[len(USER_ATTR):]: v for k, v in attrs.items()
+                           if k.startswith(USER_ATTR)},
+                "omap": store.omap_get(self.cid, oid),
+                "omap_header": store.omap_get_header(self.cid, oid),
+            }
+        try:
+            data, _size = await self._read_ec(oid)
+        except KeyError:
+            return None
+        xattrs = {}
+        try:
+            attrs = store.getattrs(self.cid, oid)
+            xattrs = {k[len(USER_ATTR):]: v for k, v in attrs.items()
+                      if k.startswith(USER_ATTR)}
+        except NotFound:
+            pass
+        return {"data": bytearray(data), "xattrs": xattrs, "omap": {},
+                "omap_header": b""}
 
     def _object_version(self, oid: bytes) -> tuple[int, int]:
         try:
@@ -248,49 +419,44 @@ class PG:
 
     def _local_txn(self, oid: bytes, payload: bytes | None,
                    version, attrs: dict[str, bytes],
-                   entry: Entry) -> tx.Transaction:
+                   entry: Entry, state: dict | None = None
+                   ) -> tx.Transaction:
         t = tx.Transaction()
         self._ensure_coll(t)
-        if payload is None:
-            if self.osd.store.exists(self.cid, oid):
-                t.remove(self.cid, oid)
-        else:
-            t.truncate(self.cid, oid, 0)
-            t.write(self.cid, oid, 0, payload)
-            t.setattrs(self.cid, oid, {ATTR_V: enc_ver(version), **attrs})
+        _object_mutation(t, self.cid, oid, payload, version, attrs, state,
+                         existed=self.osd.store.exists(self.cid, oid))
         self._append_and_persist(entry, t)
         return t
 
     @staticmethod
     def _remote_txn(cid: str, oid: bytes, payload: bytes | None,
-                    version, attrs: dict[str, bytes]) -> tx.Transaction:
+                    version, attrs: dict[str, bytes],
+                    state: dict | None = None) -> tx.Transaction:
         """Transaction shipped to a peer (its PG appends the log entry and
         persists it into the same transaction on arrival)."""
         t = tx.Transaction()
-        if payload is None:
-            t.remove(cid, oid)  # receiver filters if it never had it
-        else:
-            t.truncate(cid, oid, 0)
-            t.write(cid, oid, 0, payload)
-            t.setattrs(cid, oid, {ATTR_V: enc_ver(version), **attrs})
+        _object_mutation(t, cid, oid, payload, version, attrs, state,
+                         existed=True)
         return t
 
     async def _write_replicated(self, oid: bytes, data: bytes | None,
-                                entry: Entry) -> None:
+                                entry: Entry, state: dict | None = None
+                                ) -> None:
         version = entry.version
         peers = [(o, s) for o, s in self.live_members()
                  if o != self.osd.id]
         # local apply first (primary orders), then fan out, ack on all
         self.osd.store.queue_transaction(
-            self._local_txn(oid, data, version, {}, entry)
+            self._local_txn(oid, data, version, {}, entry, state=state)
         )
-        await self._fanout_rep(peers, oid, data, version, entry)
+        await self._fanout_rep(peers, oid, data, version, entry, state)
 
-    async def _fanout_rep(self, peers, oid, data, version, entry) -> None:
+    async def _fanout_rep(self, peers, oid, data, version, entry,
+                          state=None) -> None:
         waits = []
         for o, _s in peers:
             rt = self._remote_txn(f"{self.pgid[0]}.{self.pgid[1]}", oid,
-                                  data, version, {})
+                                  data, version, {}, state=state)
             subtid = self.osd.new_subtid()
             fut = self.osd.expect_reply(subtid)
             waits.append((o, subtid, fut))
@@ -303,7 +469,7 @@ class PG:
         await self.osd.gather(waits)
 
     async def _write_ec(self, oid: bytes, data: bytes | None,
-                        entry: Entry) -> None:
+                        entry: Entry, state: dict | None = None) -> None:
         version = entry.version
         codec = self.osd.codec_for(self.pool)
         k, n = codec.k, codec.get_chunk_count()
@@ -333,11 +499,13 @@ class PG:
             target = live[j]
             if target == self.osd.id:
                 self.osd.store.queue_transaction(
-                    self._local_txn(oid, payload, version, attrs, entry)
+                    self._local_txn(oid, payload, version, attrs, entry,
+                                    state=state)
                 )
                 continue
             cid = f"{self.pgid[0]}.{self.pgid[1]}s{j}"
-            rt = self._remote_txn(cid, oid, payload, version, attrs)
+            rt = self._remote_txn(cid, oid, payload, version, attrs,
+                                  state=state)
             subtid = self.osd.new_subtid()
             fut = self.osd.expect_reply(subtid)
             waits.append((target, subtid, fut))
@@ -511,19 +679,27 @@ class PG:
             size = denc.dec_u64(
                 self.osd.store.getattr(self.cid, m.oid, ATTR_SIZE), 0
             )[0]
+            uattrs = {
+                k: v
+                for k, v in self.osd.store.getattrs(
+                    self.cid, m.oid
+                ).items()
+                if k.startswith(USER_ATTR)
+            }
             reply = M.MECSubReadReply(tid=m.tid, pgid=self.pgid,
                                       shard=m.shard, result=M.OK,
-                                      data=chunk, digest=digest, size=size)
+                                      data=chunk, digest=digest, size=size,
+                                      attrs=uattrs)
         except (NotFound, KeyError):
             reply = M.MECSubReadReply(tid=m.tid, pgid=self.pgid,
                                       shard=m.shard, result=M.ENOENT,
-                                      data=b"", digest=0, size=0)
+                                      data=b"", digest=0, size=0, attrs={})
         except Exception:
             # EIO/corruption: distinct from "never had it" so the
             # primary can count true absence (handle_sub_read's EIO arc)
             reply = M.MECSubReadReply(tid=m.tid, pgid=self.pgid,
                                       shard=m.shard, result=M.EIO,
-                                      data=b"", digest=0, size=0)
+                                      data=b"", digest=0, size=0, attrs={})
         await self.osd.send(src, reply)
 
     # ======================================================== peering ==
@@ -718,6 +894,7 @@ class PG:
         failed: set[int] = {shard}
         size_attr = None
         remote_size = None
+        user_attrs: dict[str, bytes] = {}
         while True:
             usable = [s for s in sorted(live) if s not in failed]
             try:
@@ -741,6 +918,11 @@ class PG:
                         size_attr = self.osd.store.getattr(
                             cidj, oid, ATTR_SIZE
                         )
+                        user_attrs.update({
+                            k: v for k, v in self.osd.store.getattrs(
+                                cidj, oid
+                            ).items() if k.startswith(USER_ATTR)
+                        })
                         progress = True
                     except Exception:
                         failed.add(j)
@@ -756,6 +938,7 @@ class PG:
                 if reply.result == M.OK:
                     chunks[j] = reply.data
                     remote_size = reply.size
+                    user_attrs.update(reply.attrs)
                     progress = True
                 else:
                     failed.add(j)
@@ -768,6 +951,7 @@ class PG:
         decoded = codec.decode([shard], chunks)
         chunk = decoded[shard].tobytes()
         return chunk, {
+            **user_attrs,
             ATTR_SIZE: size_attr,
             ATTR_HINFO: denc.enc_u32(
                 native.crc32c(np.frombuffer(chunk, np.uint8))

@@ -4,11 +4,10 @@ libradosstriper role, src/libradosstriper/RadosStriperImpl.cc).
 A logical striped object ``name`` is cut by a FileLayout across RADOS
 objects ``<name>.%08x``. Writes fan out to every touched object
 concurrently (one asyncio gather — the striping parallelism is the
-point); partial-object updates are client-side read-merge-write the way
-the lite EC path needs (full-object extents skip the read). Logical
-size is tracked in the ``striper.size`` xattr-analog object attr on
-object 0 via a size-carrying header object, mirroring the reference's
-XATTR_SIZE usage.
+point); partial-object updates ride the PG op-vector engine's atomic
+server-side read-modify-write. Logical size is tracked in a
+size-carrying header object, mirroring the reference's XATTR_SIZE
+usage.
 """
 from __future__ import annotations
 
@@ -50,23 +49,12 @@ class RadosStriper:
             for bo, ln in ex.buffer_extents:
                 piece[pos : pos + ln] = data[bo : bo + ln]
                 pos += ln
-            if ex.offset == 0 and not await self._object_longer(
-                ex.oid, ex.length
-            ):
-                # extent covers the object prefix and nothing durable
-                # lies beyond it: plain full write
-                await self.client.write_full(self.pool_id, ex.oid, bytes(piece))
-                return
-            # read-merge-write (client-side RMW; EC pools take full-object
-            # writes only, the reference's overwrite restriction)
-            try:
-                old = await self.client.read(self.pool_id, ex.oid)
-            except KeyError:
-                old = b""
-            merged = bytearray(max(len(old), ex.offset + ex.length))
-            merged[: len(old)] = old
-            merged[ex.offset : ex.offset + ex.length] = piece
-            await self.client.write_full(self.pool_id, ex.oid, bytes(merged))
+            # server-side partial write: the PG's op-vector engine does
+            # the read-modify-write atomically (EC pools rebuild the
+            # full object state primary-side)
+            await self.client.write(
+                self.pool_id, ex.oid, ex.offset, bytes(piece)
+            )
 
         await asyncio.gather(*(put(ex) for ex in extents))
         new_end = offset + len(data)
@@ -76,11 +64,6 @@ class RadosStriper:
                 new_end.to_bytes(8, "little"),
             )
 
-    async def _object_longer(self, oid: bytes, length: int) -> bool:
-        try:
-            return await self.client.stat(self.pool_id, oid) > length
-        except KeyError:
-            return False
 
     # ------------------------------------------------------------- read
 

@@ -42,12 +42,19 @@ def test_message_roundtrips():
     samples = [
         M.MOSDBoot(osd=3),
         M.MOSDMapMsg(full=b"mapbytes", incrementals=[b"a", b"bb"], epoch=9),
-        M.MOSDOp(tid=5, pgid=(1, 7), oid=b"obj", op="writefull", offset=0,
-                 length=-1, data=b"\x00\x01" * 50, epoch=4),
+        M.MOSDOp(tid=5, pgid=(1, 7), oid=b"obj",
+                 ops=[M.osd_op("writefull", data=b"\x00\x01" * 50),
+                      M.osd_op("setxattr", key=b"k", data=b"v"),
+                      M.osd_op("omap_setkeys", kv={b"a": b"1"}),
+                      M.osd_op("omap_rmkeys", keys=[b"z"])],
+                 epoch=4),
+        M.MOSDOpReply(tid=5, result=0, data=b"x", size=1,
+                      outs=[(0, b"x"), (-2, b"")], epoch=4),
         M.MECSubWrite(tid=1, pgid=(2, 3), shard=4, txn=b"t", entry=b"e",
                       epoch=2),
         M.MECSubReadReply(tid=1, pgid=(2, 3), shard=4, result=0,
-                          data=b"chunk", digest=0xDEADBEEF, size=123),
+                          data=b"chunk", digest=0xDEADBEEF, size=123,
+                          attrs={"u:meta": b"m"}),
         M.MPushOp(pgid=(1, 2), shard=-1, oid=b"o", version=(3, 9),
                   data=b"d", attrs={"v": b"\x01", "hinfo": b"\x02"},
                   epoch=3, last_update=(3, 11)),
@@ -81,8 +88,7 @@ def test_tcp_messenger_roundtrip():
         a.addrbook["osd.0"] = (host, port_b)
         b.addrbook["client.1"] = (host_a, port_a)
         await a.send("osd.0", M.MOSDOp(tid=1, pgid=(1, 0), oid=b"x",
-                                       op="read", offset=0, length=-1,
-                                       data=b"", epoch=1))
+                                       ops=[M.osd_op("read")], epoch=1))
         await asyncio.wait_for(done.wait(), 5)
         await a.close()
         await b.close()

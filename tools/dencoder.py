@@ -31,10 +31,13 @@ def _samples() -> dict[str, object]:
         "MFailure": M.MFailure(target=2, reporter="osd.1"),
         "MPoolCreate": M.MPoolCreate(pool=b"P" * 16),
         "MPoolCreateReply": M.MPoolCreateReply(pool_id=1, epoch=2),
-        "MOSDOp": M.MOSDOp(tid=1, pgid=pg, oid=b"obj", op="read",
-                           offset=0, length=-1, data=b"", epoch=3),
+        "MOSDOp": M.MOSDOp(tid=1, pgid=pg, oid=b"obj",
+                           ops=[M.osd_op("read"),
+                                M.osd_op("setxattr", key=b"k",
+                                         data=b"v")],
+                           epoch=3),
         "MOSDOpReply": M.MOSDOpReply(tid=1, result=0, data=b"d", size=1,
-                                     epoch=3),
+                                     outs=[(0, b"d")], epoch=3),
         "MOSDRepOp": M.MOSDRepOp(tid=2, pgid=pg, txn=b"T", entry=b"E",
                                  epoch=3),
         "MOSDRepOpReply": M.MOSDRepOpReply(tid=2, pgid=pg, result=0,
@@ -47,7 +50,8 @@ def _samples() -> dict[str, object]:
                                    offset=0, length=-1),
         "MECSubReadReply": M.MECSubReadReply(tid=4, pgid=pg, shard=1,
                                              result=0, data=b"c",
-                                             digest=7, size=1),
+                                             digest=7, size=1,
+                                             attrs={"u:k": b"v"}),
         "MPGInfoReq": M.MPGInfoReq(pgid=pg, epoch=3, shard=0),
         "MPGInfoReply": M.MPGInfoReply(pgid=pg, epoch=3, shard=0,
                                        info=b"I"),
