@@ -289,22 +289,27 @@ class PG:
                     raise OpError(M.ENOENT)
                 out = denc.enc_u64(len(data))
             elif op == "getxattr":
+                self._check_exists(exists0, mutated)
                 k = key.decode()
                 if k not in state["xattrs"]:
                     raise OpError(ENODATA, f"xattr {k}")
                 out = state["xattrs"][k]
             elif op == "getxattrs":
+                self._check_exists(exists0, mutated)
                 out = denc.enc_map(state["xattrs"], denc.enc_str,
                                    denc.enc_bytes)
             elif op == "omap_get":
                 self._check_omap()
+                self._check_exists(exists0, mutated)
                 out = denc.enc_map(state["omap"], denc.enc_bytes,
                                    denc.enc_bytes)
             elif op == "omap_getheader":
                 self._check_omap()
+                self._check_exists(exists0, mutated)
                 out = state["omap_header"]
             elif op == "omap_getkeys":
                 self._check_omap()
+                self._check_exists(exists0, mutated)
                 out = denc.enc_list(sorted(state["omap"]), denc.enc_bytes)
             elif op == "writefull":
                 data[:] = payload
@@ -373,6 +378,11 @@ class PG:
                     await self._write_replicated(oid, bytes(data), entry,
                                                  state=state)
         return outs, len(data) if not deleted else 0
+
+    @staticmethod
+    def _check_exists(exists0: bool, mutated: bool) -> None:
+        if not exists0 and not mutated:
+            raise OpError(M.ENOENT)
 
     def _check_omap(self) -> None:
         if self.is_ec:

@@ -1,0 +1,9 @@
+"""Storage services on RADOS (the L5 layer role: librbd, RGW, cls).
+
+Thin by design (SURVEY.md §7 phase 8): capability-parity service
+surfaces built on the client op-vector API, not re-implementations of
+the reference's 400 K LoC service stack.
+"""
+from __future__ import annotations
+
+from .rbd import RBD, Image, ImageNotFound  # noqa: F401

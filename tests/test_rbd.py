@@ -1,0 +1,137 @@
+"""RBD-lite tests: image lifecycle, striped IO, snapshots, layering
+(the librbd test role)."""
+import asyncio
+
+import numpy as np
+import pytest
+
+from ceph_tpu.cluster.vstart import TestCluster
+from ceph_tpu.osdc.striper import FileLayout
+from ceph_tpu.placement.osdmap import Pool
+from ceph_tpu.services import RBD, ImageNotFound
+from ceph_tpu.services.rbd import ImageExists
+
+LAYOUT = FileLayout(stripe_unit=8192, stripe_count=1, object_size=8192)
+
+
+def run(coro):
+    asyncio.run(asyncio.wait_for(coro, 120))
+
+
+async def make():
+    c = TestCluster(n_osds=4)
+    await c.start()
+    await c.client.create_pool(
+        Pool(id=1, name="rbd", size=3, pg_num=8, crush_rule=0)
+    )
+    await c.wait_active(20)
+    return c, RBD(c.client, 1)
+
+
+def test_image_lifecycle_and_io():
+    async def t():
+        c, rbd = await make()
+        await rbd.create("disk", 64 * 1024, LAYOUT)
+        with pytest.raises(ImageExists):
+            await rbd.create("disk", 1024)
+        img = await rbd.open("disk")
+        assert img.size == 64 * 1024
+        rng = np.random.default_rng(3)
+        data = rng.integers(0, 256, 30000, dtype=np.uint8).tobytes()
+        await img.write(1000, data)
+        assert await img.read(1000, 30000) == data
+        # holes read as zeros
+        assert await img.read(40000, 100) == b"\0" * 100
+        # cross-object overwrite
+        await img.write(8000, b"B" * 400)
+        got = await img.read(7990, 420)
+        assert got[10:410] == b"B" * 400
+        # discard zeroes a range
+        await img.discard(1000, 500)
+        assert await img.read(1000, 500) == b"\0" * 500
+        with pytest.raises(IOError):
+            await img.write(64 * 1024 - 10, b"x" * 20)  # past end
+        await c.stop()
+
+    run(t())
+
+
+def test_resize_and_remove():
+    async def t():
+        c, rbd = await make()
+        await rbd.create("vol", 40960, LAYOUT)  # 5 objects
+        img = await rbd.open("vol")
+        await img.write(0, b"A" * 40960)
+        await img.resize(12000)  # shrink into object 1
+        assert img.size == 12000
+        assert await img.read(0, 12000) == b"A" * 12000
+        await img.resize(20000)  # grow: new bytes read as zeros
+        got = await img.read(0, 20000)
+        assert got[:12000] == b"A" * 12000
+        assert got[12000:] == b"\0" * 8000
+        await rbd.remove("vol")
+        with pytest.raises(ImageNotFound):
+            await rbd.open("vol")
+        await c.stop()
+
+    run(t())
+
+
+def test_snapshots_and_rollback():
+    async def t():
+        c, rbd = await make()
+        await rbd.create("img", 32768, LAYOUT)
+        img = await rbd.open("img")
+        await img.write(0, b"v1" * 8000)
+        await img.snap_create("s1")
+        await img.write(0, b"v2" * 8000)
+        assert await img.read(0, 16000) == b"v2" * 8000
+        # read-at-snap sees the old data
+        at_s1 = await rbd.open("img", snap="s1")
+        assert await at_s1.read(0, 16000) == b"v1" * 8000
+        with pytest.raises(IOError):
+            await at_s1.write(0, b"nope")
+        assert await img.snap_list() == ["s1"]
+        await img.snap_rollback("s1")
+        assert await img.read(0, 16000) == b"v1" * 8000
+        await img.snap_remove("s1")
+        assert await img.snap_list() == []
+        # removing an image with snapshots is refused
+        await img.snap_create("s2")
+        with pytest.raises(RuntimeError):
+            await rbd.remove("img")
+        await c.stop()
+
+    run(t())
+
+
+def test_clone_cow_and_flatten():
+    async def t():
+        c, rbd = await make()
+        await rbd.create("base", 32768, LAYOUT)
+        base = await rbd.open("base")
+        await base.write(0, b"GOLD" * 4096)  # 16384 bytes, 2 objects
+        await base.snap_create("gold")
+        await rbd.clone("base", "gold", "child")
+        child = await rbd.open("child")
+        assert child.parent == ("base", "gold")
+        # unwritten child extents read through to the parent snapshot
+        assert await child.read(0, 16384) == b"GOLD" * 4096
+        # COW: writing the child leaves the parent untouched
+        await child.write(0, b"EDIT")
+        assert (await child.read(0, 8))[:4] == b"EDIT"
+        assert await base.read(0, 8) == b"GOLDGOLD"
+        # the copied-up object carries the rest of the parent bytes
+        assert await child.read(4, 100) == (b"GOLD" * 30)[4 - 4:100]
+        # parent changes after the snap are invisible to the child
+        await base.write(8192, b"NEWBASE!")
+        assert await child.read(8192, 8) == b"GOLD" * 2
+        await child.flatten()
+        assert child.parent is None
+        # flatten made the child self-contained: removing base works
+        await base.snap_remove("gold")
+        await rbd.remove("base")
+        assert await child.read(0, 4) == b"EDIT"
+        await c.stop()
+
+    run(t())
