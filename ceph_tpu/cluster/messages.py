@@ -304,6 +304,18 @@ class MPGScanReply(Message):
               ("objects", "map:bytes:" + EVERSION))
 
 
+# -------------------------------------------------------------------- mgr
+
+
+@register_message
+class MMgrReport(Message):
+    TYPE = 55
+    # perf: JSON-encoded perf-dump (control plane; schema-free like the
+    # reference's MMgrReport counter payloads), pgs: state -> count
+    FIELDS = (("osd", "u32"), ("epoch", "u32"), ("perf", "bytes"),
+              ("pgs", "map:str:u32"))
+
+
 # ------------------------------------------------------------------ scrub
 
 

@@ -1,0 +1,147 @@
+"""MgrLite: stats aggregation, health, and metrics export (the
+src/mgr DaemonServer/ClusterState role plus the prometheus module +
+src/exporter role).
+
+Daemons push MMgrReport on their heartbeat cadence (perf-dump JSON +
+per-PG state counts); the mgr keeps the latest report per OSD, serves
+cluster status / health checks, and renders a Prometheus text
+exposition. Health mirrors the reference's checks it can see:
+OSD_DOWN (map), PG_NOT_ACTIVE (reports), MGR_STALE_REPORTS (silence).
+All surfaces are exposed on an admin socket ('ceph status' /
+'ceph health' / exporter scrape roles).
+"""
+from __future__ import annotations
+
+import json
+import time
+
+from ..utils.admin import AdminSocket
+from . import messages as M
+
+HEALTH_OK = "HEALTH_OK"
+HEALTH_WARN = "HEALTH_WARN"
+HEALTH_ERR = "HEALTH_ERR"
+
+
+class MgrLite:
+    def __init__(self, bus, mon, stale_secs: float = 5.0):
+        self.bus = bus
+        self.mon = mon
+        self.name = "mgr"
+        self.stale_secs = stale_secs
+        self.reports: dict[int, dict] = {}  # osd -> {ts, epoch, perf, pgs}
+        self.admin: AdminSocket | None = None
+
+    # ---------------------------------------------------------- lifecycle
+
+    async def start(self) -> None:
+        self.bus.register(self.name, self.handle)
+
+    async def stop(self) -> None:
+        self.bus.unregister(self.name)
+        if self.admin is not None:
+            await self.admin.stop()
+            self.admin = None
+
+    async def start_admin(self, path: str) -> None:
+        sock = AdminSocket(path)
+        sock.register("status", lambda a: self.status(),
+                      "cluster status (ceph -s role)")
+        sock.register("health", lambda a: self.health(),
+                      "health checks")
+        sock.register("prometheus", lambda a: self.render_prometheus(),
+                      "metrics exposition text")
+        await sock.start()
+        self.admin = sock
+
+    async def handle(self, src: str, msg) -> None:
+        if isinstance(msg, M.MMgrReport):
+            self.reports[msg.osd] = {
+                "ts": time.time(),
+                "epoch": msg.epoch,
+                "perf": json.loads(msg.perf.decode() or "{}"),
+                "pgs": dict(msg.pgs),
+            }
+
+    # ------------------------------------------------------------ surface
+
+    def status(self) -> dict:
+        osdmap = self.mon.osdmap
+        up = sum(1 for o in osdmap.osds if o.up)
+        inn = sum(1 for o in osdmap.osds if o.weight > 0)
+        pg_states: dict[str, int] = {}
+        ops = 0
+        for rep in self.reports.values():
+            for state, n in rep["pgs"].items():
+                pg_states[state] = pg_states.get(state, 0) + n
+            ops += int(rep["perf"].get("op", 0))
+        return {
+            "health": self.health()["status"],
+            "epoch": osdmap.epoch,
+            "osds": {"total": osdmap.n_osds, "up": up, "in": inn},
+            "pools": len(osdmap.pools),
+            "pgs": pg_states,
+            "client_ops_total": ops,
+        }
+
+    def health(self) -> dict:
+        checks: dict[str, str] = {}
+        osdmap = self.mon.osdmap
+        down = [i for i, o in enumerate(osdmap.osds)
+                if o.exists and not o.up]
+        if down:
+            checks["OSD_DOWN"] = f"{len(down)} osds down: {down}"
+        now = time.time()
+        stale = [o for o, rep in self.reports.items()
+                 if now - rep["ts"] > self.stale_secs
+                 and o not in down
+                 and osdmap.osds[o].up]
+        if stale:
+            checks["MGR_STALE_REPORTS"] = (
+                f"no recent reports from osds {sorted(stale)}"
+            )
+        inactive = 0
+        for o, rep in self.reports.items():
+            if osdmap.osds[o].up:
+                inactive += sum(
+                    n for state, n in rep["pgs"].items()
+                    if state != "active"
+                )
+        if inactive:
+            checks["PG_NOT_ACTIVE"] = f"{inactive} pg instances not active"
+        status = HEALTH_OK if not checks else HEALTH_WARN
+        return {"status": status, "checks": checks}
+
+    def render_prometheus(self) -> str:
+        """Exposition text (prometheus mgr module / src/exporter role)."""
+        lines = [
+            "# HELP ceph_osd_up OSD liveness per the cluster map",
+            "# TYPE ceph_osd_up gauge",
+        ]
+        osdmap = self.mon.osdmap
+        for i, o in enumerate(osdmap.osds):
+            lines.append(f'ceph_osd_up{{osd="{i}"}} {1 if o.up else 0}')
+        lines.append("# TYPE ceph_osd_op_total counter")
+        for osd, rep in sorted(self.reports.items()):
+            for key, val in sorted(rep["perf"].items()):
+                if isinstance(val, (int, float)):
+                    lines.append(
+                        f'ceph_osd_{key}_total{{osd="{osd}"}} {val}'
+                    )
+                elif isinstance(val, dict) and "sum" in val \
+                        and "avgcount" in val:
+                    lines.append(
+                        f'ceph_osd_{key}_sum{{osd="{osd}"}} {val["sum"]}'
+                    )
+                    lines.append(
+                        f'ceph_osd_{key}_count{{osd="{osd}"}} '
+                        f'{val["avgcount"]}'
+                    )
+        lines.append("# TYPE ceph_pg_states gauge")
+        states: dict[str, int] = {}
+        for rep in self.reports.values():
+            for s, n in rep["pgs"].items():
+                states[s] = states.get(s, 0) + n
+        for s, n in sorted(states.items()):
+            lines.append(f'ceph_pg_states{{state="{s}"}} {n}')
+        return "\n".join(lines) + "\n"

@@ -28,6 +28,7 @@ from ..utils.admin import AdminSocket
 from ..utils.fault import FaultInjector
 from ..utils.perf import PerfCounters
 from . import messages as M
+from .optracker import OpTracker
 from .pg import NONE, PG
 from .scheduler import CLIENT, RECOVERY, SCRUB, MClockScheduler, Throttle
 
@@ -133,6 +134,7 @@ class OSDLite:
         # QoS between client / recovery / scrub traffic (mClock role)
         self.op_scheduler = MClockScheduler()
         self.throttle = Throttle(self.conf["osd_client_message_size_cap"])
+        self.optracker = OpTracker()
         self.pending: dict = {}  # key -> Future (sub-op replies)
         self._subtid = 0
         self._codecs: dict[int, object] = {}
@@ -289,6 +291,18 @@ class OSDLite:
                        "pgs": len(self.pgs), "stopped": self.stopped},
             "daemon status",
         )
+        sock.register(
+            "dump_ops_in_flight",
+            lambda a: self.optracker.dump_ops_in_flight(),
+            "in-flight client ops with event timelines",
+        )
+        sock.register(
+            "dump_historic_ops",
+            lambda a: self.optracker.dump_historic_ops(
+                int(a.get("limit", 20))
+            ),
+            "recently completed ops with event timelines",
+        )
         await sock.start()
         self.admin = sock
 
@@ -310,6 +324,8 @@ class OSDLite:
                 pg._peer_task.cancel()
 
     async def _hb_loop(self) -> None:
+        import json
+
         while True:
             try:
                 await self.bus.send(
@@ -318,6 +334,20 @@ class OSDLite:
                 )
             except Exception:
                 pass
+            try:
+                pgs: dict[str, int] = {}
+                for pg in self.pgs.values():
+                    pgs[pg.state] = pgs.get(pg.state, 0) + 1
+                await self.bus.send(
+                    self.name, "mgr",
+                    M.MMgrReport(
+                        osd=self.id, epoch=self.epoch,
+                        perf=json.dumps(self.perf.dump()).encode(),
+                        pgs=pgs,
+                    ),
+                )
+            except Exception:
+                pass  # no mgr registered: reports are best-effort
             await asyncio.sleep(self.hb_interval)
 
     # ------------------------------------------------------------ dispatch
@@ -338,8 +368,14 @@ class OSDLite:
             # the ingest byte throttle; sub-ops and control traffic stay
             # fast-dispatch
             await self.throttle.acquire(_op_bytes(msg))
+            tracked = self.optracker.create(
+                f"osd_op tid={msg.tid} {msg.oid!r} "
+                f"[{','.join(o[0] for o in msg.ops)}]"
+            )
             self.op_scheduler.enqueue(
-                CLIENT, lambda src=src, msg=msg: self._client_op(src, msg)
+                CLIENT,
+                lambda src=src, msg=msg, tr=tracked:
+                    self._client_op(src, msg, tr),
             )
         elif isinstance(msg, M.MPull):
             pg = self._ensure_pg(msg.pgid, msg.shard)
@@ -400,18 +436,27 @@ class OSDLite:
         elif isinstance(msg, M.MScrubReply):
             self._resolve(msg.tid, msg)
 
-    async def _client_op(self, src: str, msg: M.MOSDOp) -> None:
+    async def _client_op(self, src: str, msg: M.MOSDOp,
+                         tracked=None) -> None:
+        if tracked is not None:
+            tracked.mark("dequeued")
         try:
             pg = self._pg_for_primary(msg.pgid)
             if pg is None:
+                if tracked is not None:
+                    tracked.mark("estale")
                 await self.send(
                     src,
                     M.MOSDOpReply(tid=msg.tid, result=M.ESTALE, data=b"",
                                   size=0, outs=[], epoch=self.epoch),
                 )
                 return
+            if tracked is not None:
+                tracked.mark("reached_pg")
             await pg.do_op(src, msg)
         finally:
+            if tracked is not None:
+                self.optracker.finish(tracked)
             self.throttle.release(_op_bytes(msg))
 
     def _my_shard(self, pgid, msg_shard: int) -> int:

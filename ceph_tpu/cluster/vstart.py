@@ -14,6 +14,7 @@ from ..msg.messenger import LocalBus
 from ..placement import crushmap as cm
 from ..store.memstore import MemStore
 from .client import RadosClient
+from .mgr import MgrLite
 from .mon import MonLite
 from .osd import OSDLite
 
@@ -29,10 +30,12 @@ class TestCluster:
         self.stores = [MemStore() for _ in range(n_osds)]
         self.osds: list[OSDLite | None] = [None] * n_osds
         self.hb_interval = hb_interval
+        self.mgr = MgrLite(self.bus, self.mon)
         self.client = RadosClient(self.bus)
 
     async def start(self) -> None:
         await self.mon.start()
+        await self.mgr.start()
         for i in range(self.n_osds):
             await self.start_osd(i)
         await self.client.connect()
@@ -43,6 +46,7 @@ class TestCluster:
             if osd is not None:
                 await osd.stop()
                 self.osds[i] = None
+        await self.mgr.stop()
         await self.mon.stop()
 
     async def start_osd(self, i: int) -> OSDLite:

@@ -1,0 +1,94 @@
+"""MgrLite + OpTracker tests (DaemonServer/ClusterState, prometheus
+exporter, OpRequest dump_historic_ops roles)."""
+import asyncio
+
+from ceph_tpu.cluster.vstart import TestCluster
+from ceph_tpu.placement.osdmap import Pool
+from ceph_tpu.utils.admin import admin_command
+
+
+def run(coro):
+    asyncio.run(asyncio.wait_for(coro, 120))
+
+
+async def make():
+    c = TestCluster(n_osds=4)
+    await c.start()
+    await c.client.create_pool(
+        Pool(id=1, name="p", size=3, pg_num=8, crush_rule=0)
+    )
+    await c.wait_active(20)
+    return c
+
+
+def test_mgr_status_and_health():
+    async def t():
+        c = await make()
+        for i in range(5):
+            await c.client.write_full(1, f"o{i}", b"x" * 100)
+        await asyncio.sleep(c.hb_interval * 3)  # reports flow on hb
+        st = c.mgr.status()
+        assert st["osds"] == {"total": 4, "up": 4, "in": 4}
+        assert st["pools"] == 1
+        assert st["pgs"].get("active", 0) > 0
+        assert st["client_ops_total"] >= 5
+        assert st["health"] == "HEALTH_OK"
+        # kill an OSD: health degrades to WARN with OSD_DOWN
+        await c.kill_osd(3)
+        await c.wait_down(3, 20)
+        h = c.mgr.health()
+        assert h["status"] == "HEALTH_WARN"
+        assert "OSD_DOWN" in h["checks"]
+        await c.stop()
+
+    run(t())
+
+
+def test_mgr_prometheus_exposition(tmp_path):
+    async def t():
+        c = await make()
+        await c.client.write_full(1, "obj", b"data")
+        await asyncio.sleep(c.hb_interval * 3)
+        await c.mgr.start_admin(str(tmp_path / "mgr.sock"))
+        text = await admin_command(c.mgr.admin.path, "prometheus")
+        assert 'ceph_osd_up{osd="0"} 1' in text
+        assert "ceph_osd_op_total" in text
+        assert 'ceph_pg_states{state="active"}' in text
+        status = await admin_command(c.mgr.admin.path, "status")
+        assert status["osds"]["up"] == 4
+        health = await admin_command(c.mgr.admin.path, "health")
+        assert health["status"] == "HEALTH_OK"
+        await c.stop()
+
+    run(t())
+
+
+def test_optracker_timelines(tmp_path):
+    async def t():
+        c = await make()
+        for i in range(3):
+            await c.client.write_full(1, f"t{i}", b"payload")
+            await c.client.read(1, f"t{i}")
+        # find the OSD(s) that served ops and check their history
+        total_hist = 0
+        for osd in c.osds:
+            hist = osd.optracker.dump_historic_ops()
+            total_hist += hist["num_ops"]
+            for op in hist["ops"]:
+                events = [e["event"] for e in op["events"]]
+                assert events[0] == "queued"
+                assert "dequeued" in events
+                assert events[-1] == "done"
+                assert op["duration"] is not None
+                assert "osd_op" in op["description"]
+            assert osd.optracker.dump_ops_in_flight()["num_ops"] == 0
+        assert total_hist >= 6
+        # admin socket surface
+        osd = next(o for o in c.osds
+                   if o.optracker.dump_historic_ops()["num_ops"])
+        await osd.start_admin(str(tmp_path / "osd.sock"))
+        dump = await admin_command(osd.admin.path, "dump_historic_ops")
+        assert dump["num_ops"] >= 1
+        await c.stop()
+
+    run(t())
