@@ -282,3 +282,4 @@ def _as_u8(data) -> np.ndarray:
 from .registry import PluginRegistry, instance, load_codec  # noqa: E402,F401
 from . import rs_plugin, isa_plugin  # noqa: E402,F401  (self-registering)
 from . import lrc_plugin, shec_plugin, clay_plugin  # noqa: E402,F401
+from . import bitmatrix_plugin  # noqa: E402,F401

@@ -1,0 +1,268 @@
+"""Bitmatrix erasure codes: the jerasure bit-matrix technique family
+(ErasureCodeJerasure.h:163-246 roles — blaum_roth, liberation,
+liber8tion, and bitmatrix cauchy).
+
+A bitmatrix code works over GF(2): each chunk splits into w packet
+rows; coding row r is the XOR of the data rows selected by row r of a
+(m*w x k*w) binary matrix. XOR-only encode is why the reference runs
+these for RAID6 — and it maps perfectly onto TPU vector units (pure
+bitwise ops, no tables).
+
+Techniques:
+- ``blaum_roth`` (m=2, w with w+1 prime): the published Blaum-Roth
+  construction over the ring GF(2)[x]/(1+x+..+x^w); Q-block for data
+  column j is multiplication by x^j in that ring.
+- ``liberation`` (m=2, w prime >= k): Plank's FAST'08 minimum-density
+  construction — Q-block X_0 = I; X_i = rotate-down-by-i plus one
+  extra bit; verified MDS here by exhaustive 2-erasure decode tests.
+- ``liber8tion`` (m=2, w=8, k<=8): the liberation-style shape at w=8.
+- ``cauchy_bm`` (any m): the GF(2^8) cauchy_good matrix lifted to
+  bit-matrices (jerasure_matrix_to_bitmatrix semantics: bit-block of
+  element e has column c equal to the bits of e*x^c).
+
+Packet layout note: chunks are split into w equal rows
+(packetsize = chunk_size / w). The reference's schedule encoder tiles
+chunks into fixed `packetsize` regions instead, so byte layouts are
+NOT wire-interchangeable with jerasure shards (the jerasure/gf-complete
+submodules are absent from this checkout, so there is no oracle to pin
+against); erasure tolerance and the matrix algebra match the published
+constructions and are exhaustively tested.
+
+Decode is fully generic: stack the surviving row-blocks of the
+generator [I; B], invert the (k*w)^2 GF(2) system once per erasure
+pattern (cached), XOR-combine surviving packet rows.
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from ..ops import gf8
+from . import ECError, ErasureCode
+from .registry import register
+
+
+def _is_prime(n: int) -> bool:
+    if n < 2:
+        return False
+    return all(n % i for i in range(2, int(n ** 0.5) + 1))
+
+
+# ------------------------------------------------------ constructions
+
+
+def _ring_mul_matrix(j: int, w: int) -> np.ndarray:
+    """w x w binary matrix of multiplication by x^j in
+    GF(2)[x]/(M_p(x)), M_p(x) = 1 + x + ... + x^w (p = w+1 prime) —
+    the Blaum-Roth ring. Column c = coefficients of x^(j+c) mod M_p."""
+    out = np.zeros((w, w), dtype=np.uint8)
+    for c in range(w):
+        out[:, c] = _x_pow_mod(j + c, w)
+    return out
+
+
+def _x_pow_mod(e: int, w: int) -> np.ndarray:
+    """Coefficient vector of x^e mod M_p(x) = 1 + x + ... + x^w.
+
+    M_p divides x^p + 1 (p = w+1), so x^p = 1 in the quotient ring:
+    reduce the exponent mod p, then x^r is a monomial for r < w and
+    x^w = 1 + x + ... + x^(w-1)."""
+    r = e % (w + 1)
+    poly = np.zeros(w, dtype=np.uint8)
+    if r < w:
+        poly[r] = 1
+    else:  # r == w
+        poly[:] = 1
+    return poly
+
+
+def _rotation(i: int, w: int) -> np.ndarray:
+    """R^i: ones at (r, (r + i) % w)."""
+    out = np.zeros((w, w), dtype=np.uint8)
+    for r in range(w):
+        out[r, (r + i) % w] = 1
+    return out
+
+
+def _liberation_block(i: int, w: int) -> np.ndarray:
+    """Q-block X_i of the Liberation code (Plank FAST'08): X_0 = I;
+    X_i (i>0) = R^i plus one extra bit at row y = i*(w-1)/2 mod w,
+    column (y + i - 1) mod w."""
+    if i == 0:
+        return np.eye(w, dtype=np.uint8)
+    out = _rotation(i, w)
+    y = (i * (w - 1) // 2) % w
+    out[y, (y + i - 1) % w] ^= 1
+    return out
+
+
+@functools.lru_cache(maxsize=128)
+def _bitmatrix(technique: str, k: int, m: int, w: int) -> np.ndarray:
+    """(m*w, k*w) coding bitmatrix."""
+    if technique == "blaum_roth":
+        if m != 2:
+            raise ECError("blaum_roth is a RAID6 code (m=2)")
+        if not _is_prime(w + 1):
+            raise ECError(f"blaum_roth needs w+1 prime, w={w}")
+        if k > w:
+            raise ECError(f"blaum_roth needs k <= w ({k} > {w})")
+        rows = [np.hstack([np.eye(w, dtype=np.uint8)] * k)]
+        rows.append(np.hstack([_ring_mul_matrix(j, w) for j in range(k)]))
+        return np.vstack(rows)
+    if technique == "liberation":
+        if m != 2:
+            raise ECError("liberation is a RAID6 code (m=2)")
+        if not _is_prime(w):
+            raise ECError(f"liberation needs prime w, got {w}")
+        if k > w:
+            raise ECError(f"liberation needs k <= w ({k} > {w})")
+        rows = [np.hstack([np.eye(w, dtype=np.uint8)] * k)]
+        rows.append(np.hstack([_liberation_block(i, w) for i in range(k)]))
+        return np.vstack(rows)
+    if technique == "liber8tion":
+        # w=8 RAID6 role. The published Liber8tion matrix lives in the
+        # absent jerasure submodule; the Q row here is the classic
+        # GF(2^8) generator-power construction (Q-block for column j =
+        # bit-block of g^j), provably MDS for k <= 255 — same
+        # parameters and XOR-schedule shape, denser matrix.
+        if m != 2:
+            raise ECError("liber8tion is a RAID6 code (m=2)")
+        if w != 8:
+            raise ECError("liber8tion fixes w=8")
+        if k > w:
+            raise ECError(f"liber8tion needs k <= w ({k} > 8)")
+        rows = [np.hstack([np.eye(w, dtype=np.uint8)] * k)]
+        rows.append(np.hstack([
+            _gf_bit_block(gf8.gf_pow(2, j)) for j in range(k)
+        ]))
+        return np.vstack(rows)
+    if technique == "cauchy_bm":
+        if w != 8:
+            raise ECError("cauchy_bm runs at w=8")
+        gf_matrix = gf8.cauchy_good_matrix(k, m)
+        blocks = []
+        for i in range(m):
+            row = [
+                _gf_bit_block(int(gf_matrix[i, j])) for j in range(k)
+            ]
+            blocks.append(np.hstack(row))
+        return np.vstack(blocks)
+    raise ECError(f"unknown bitmatrix technique {technique!r}")
+
+
+def _gf_bit_block(e: int) -> np.ndarray:
+    """jerasure_matrix_to_bitmatrix semantics: column c of the 8x8
+    block holds the bits of e * x^c in GF(2^8)."""
+    out = np.zeros((8, 8), dtype=np.uint8)
+    v = e
+    for c in range(8):
+        for r in range(8):
+            out[r, c] = (v >> r) & 1
+        v = gf8.gf_mul(v, 2)
+    return out
+
+
+@functools.lru_cache(maxsize=4096)
+def _recovery_plan(technique: str, k: int, m: int, w: int,
+                   present: tuple[int, ...]) -> np.ndarray:
+    """(k*w, len(present)*w) GF(2) matrix mapping surviving packet rows
+    to the data packet rows (generator-submatrix inverse)."""
+    bm = _bitmatrix(technique, k, m, w)
+    gen = np.vstack([np.eye(k * w, dtype=np.uint8), bm])  # (n*w, k*w)
+    rows = np.vstack([gen[c * w : (c + 1) * w] for c in present])
+    if rows.shape[0] < k * w:
+        raise ECError("not enough chunks to decode")
+    # GF(2) row-reduce [rows | I]: after elimination the augmented
+    # half's first k*w rows map survivor rows to data rows
+    aug = np.hstack([
+        rows, np.eye(rows.shape[0], dtype=np.uint8)
+    ])
+    r = 0
+    for c in range(k * w):
+        pivot = next(
+            (i for i in range(r, aug.shape[0]) if aug[i, c]), None
+        )
+        if pivot is None:
+            raise ECError(
+                f"{technique} k={k} w={w}: erasure pattern "
+                f"{present} not decodable"
+            )
+        aug[[r, pivot]] = aug[[pivot, r]]
+        for i in range(aug.shape[0]):
+            if i != r and aug[i, c]:
+                aug[i] ^= aug[r]
+        r += 1
+    return aug[: k * w, k * w :]
+
+
+class BitmatrixCodec(ErasureCode):
+    """Generic bitmatrix codec over packet rows."""
+
+    DEFAULT_W = {"blaum_roth": 6, "liberation": 7, "liber8tion": 8,
+                 "cauchy_bm": 8}
+
+    def init(self, profile) -> None:
+        super().init(profile)
+        self.technique = self.profile.get("technique", "liberation")
+        if self.technique not in self.DEFAULT_W:
+            raise ECError(
+                f"bitmatrix technique must be one of "
+                f"{sorted(self.DEFAULT_W)}"
+            )
+        self.k = self.to_int("k", 4)
+        self.m = self.to_int("m", 2)
+        self.w = self.to_int("w", self.DEFAULT_W[self.technique])
+        self.matrix = _bitmatrix(self.technique, self.k, self.m, self.w)
+        self._parse_mapping()
+
+    def get_alignment(self) -> int:
+        # each chunk splits into w packet rows of whole words
+        return self.k * self.w * 4
+
+    def _rows(self, chunks: np.ndarray) -> np.ndarray:
+        """(c, L) chunks -> (c*w, L/w) packet rows."""
+        c, L = chunks.shape
+        if L % self.w:
+            raise ECError(f"chunk size {L} not divisible by w={self.w}")
+        return chunks.reshape(c * self.w, L // self.w)
+
+    def encode_chunks(self, data_chunks: np.ndarray) -> np.ndarray:
+        data_chunks = np.ascontiguousarray(data_chunks, dtype=np.uint8)
+        rows = self._rows(data_chunks)
+        out = _gf2_apply(self.matrix, rows)
+        return out.reshape(self.m, -1)
+
+    def decode_chunks(self, present, chunks: np.ndarray):
+        present = tuple(present)
+        chunks = np.ascontiguousarray(chunks, dtype=np.uint8)
+        plan = _recovery_plan(self.technique, self.k, self.m, self.w,
+                              present)
+        rows = self._rows(chunks)
+        data_rows = _gf2_apply(plan, rows)
+        data = data_rows.reshape(self.k, -1)
+        out = {i: data[i] for i in range(self.k)}
+        missing_parity = set(range(self.k, self.k + self.m)) - set(present)
+        if missing_parity:
+            coding = self.encode_chunks(data)
+            for j in missing_parity:
+                out[j] = coding[j - self.k]
+        for row_i, idx in enumerate(present):
+            if idx >= self.k:
+                out[idx] = chunks[row_i]
+        return out
+
+
+def _gf2_apply(matrix: np.ndarray, rows: np.ndarray) -> np.ndarray:
+    """XOR-combine packet rows per a binary matrix: out[r] = XOR of
+    rows[c] where matrix[r, c] = 1 (the schedule-encode role; on
+    device this is one bitwise matmul)."""
+    out = np.zeros((matrix.shape[0], rows.shape[1]), dtype=np.uint8)
+    for r in range(matrix.shape[0]):
+        idx = np.nonzero(matrix[r])[0]
+        if idx.size:
+            out[r] = np.bitwise_xor.reduce(rows[idx], axis=0)
+    return out
+
+
+register("bitmatrix", BitmatrixCodec)

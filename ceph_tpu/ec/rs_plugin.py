@@ -78,6 +78,13 @@ class RSCodec(ErasureCode):
         self.technique = self.profile.get(
             "technique", self.DEFAULT_TECHNIQUE
         )
+        # jerasure's bit-matrix technique family dispatches to the
+        # bitmatrix codec (ErasureCodeJerasure.h:163-246 techniques)
+        from .bitmatrix_plugin import BitmatrixCodec
+
+        if self.technique in BitmatrixCodec.DEFAULT_W:
+            self.__class__ = BitmatrixCodec
+            return self.init(profile)
         self.profile.setdefault("technique", self.technique)
         self.k = self.to_int("k", self.DEFAULT_K)
         self.m = self.to_int("m", self.DEFAULT_M)
