@@ -200,6 +200,14 @@ def encode_osdmap(m: OSDMap) -> bytes:
         )
     )
     out.append(denc.enc_map(m.pg_upmap_primaries, enc_pg, denc.enc_i32))
+    out.append(
+        denc.enc_map(m.pg_temp, enc_pg,
+                     lambda v: denc.enc_list(v, denc.enc_i32))
+    )
+    out.append(denc.enc_map(m.primary_temp, enc_pg, denc.enc_i32))
+    out.append(
+        denc.enc_map(m.primary_affinity, denc.enc_u32, denc.enc_u32)
+    )
     return b"".join(out)
 
 
@@ -240,6 +248,13 @@ def decode_osdmap(buf: bytes, off: int = 0) -> tuple[OSDMap, int]:
 
     m.pg_upmap_items, off = denc.dec_map(buf, off, dec_pg, dec_pairs)
     m.pg_upmap_primaries, off = denc.dec_map(buf, off, dec_pg, denc.dec_i32)
+    m.pg_temp, off = denc.dec_map(
+        buf, off, dec_pg, lambda b, o: denc.dec_list(b, o, denc.dec_i32)
+    )
+    m.primary_temp, off = denc.dec_map(buf, off, dec_pg, denc.dec_i32)
+    m.primary_affinity, off = denc.dec_map(
+        buf, off, denc.dec_u32, denc.dec_u32
+    )
     return m, off
 
 
@@ -271,6 +286,13 @@ def encode_incremental(inc: Incremental) -> bytes:
                  for k, v in inc.new_pg_upmap_primaries.items()},
                 enc_pg, denc.enc_i32,
             ),
+            denc.enc_map(
+                inc.new_pg_temp, enc_pg,
+                lambda v: denc.enc_list(v, denc.enc_i32),
+            ),
+            denc.enc_map(inc.new_primary_temp, enc_pg, denc.enc_i32),
+            denc.enc_map(inc.new_primary_affinity, denc.enc_u32,
+                         denc.enc_u32),
         )
     )
 
@@ -300,6 +322,11 @@ def decode_incremental(buf: bytes, off: int = 0) -> tuple[Incremental, int]:
 
     items, off = denc.dec_map(buf, off, dec_pg, dec_pairs)
     prims, off = denc.dec_map(buf, off, dec_pg, denc.dec_i32)
+    pg_temp, off = denc.dec_map(
+        buf, off, dec_pg, lambda b, o: denc.dec_list(b, o, denc.dec_i32)
+    )
+    ptemp, off = denc.dec_map(buf, off, dec_pg, denc.dec_i32)
+    paff, off = denc.dec_map(buf, off, denc.dec_u32, denc.dec_u32)
     return (
         Incremental(
             epoch=epoch, up=up, down=down, weights=weights, new_pools=pools,
@@ -307,6 +334,8 @@ def decode_incremental(buf: bytes, off: int = 0) -> tuple[Incremental, int]:
             new_pg_upmap_primaries={
                 k: (None if v == -1 else v) for k, v in prims.items()
             },
+            new_pg_temp=pg_temp, new_primary_temp=ptemp,
+            new_primary_affinity=paff,
         ),
         off,
     )

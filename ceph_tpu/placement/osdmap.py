@@ -139,6 +139,13 @@ class OSDMap:
         self.pg_upmap: dict[tuple[int, int], list[int]] = {}
         self.pg_upmap_items: dict[tuple[int, int], list[tuple[int, int]]] = {}
         self.pg_upmap_primaries: dict[tuple[int, int], int] = {}
+        #: peering-time overrides (OSDMap pg_temp/primary_temp role):
+        #: the mon installs these while backfill runs so IO keeps
+        #: flowing to the old holders
+        self.pg_temp: dict[tuple[int, int], list[int]] = {}
+        self.primary_temp: dict[tuple[int, int], int] = {}
+        #: per-osd 16.16 primary affinity (0x10000 = default)
+        self.primary_affinity: dict[int, int] = {}
         self._out_weights_cache: np.ndarray | None = None
 
     # ------------------------------------------------------------- state
@@ -254,16 +261,89 @@ class OSDMap:
                 return o
         return -1
 
+    def _apply_primary_affinity(self, pps: int, pool: Pool,
+                                up: list[int]) -> int:
+        """OSDMap::_apply_primary_affinity: hash the (pg seed, osd)
+        pair against each candidate's affinity so a proportional share
+        of its PGs rejects it as primary; replicated pools shift the
+        chosen primary to the front."""
+        if not self.primary_affinity:
+            return self._pick_primary(up)
+        if not any(
+            o != ITEM_NONE
+            and self.primary_affinity.get(o, 0x10000) != 0x10000
+            for o in up
+        ):
+            return self._pick_primary(up)
+        pos = -1
+        for i, o in enumerate(up):
+            if o == ITEM_NONE:
+                continue
+            a = self.primary_affinity.get(o, 0x10000)
+            if a < 0x10000 and (
+                native.crush_hash32_2(pps, o) >> 16
+            ) >= a:
+                if pos < 0:
+                    pos = i  # fallback if everyone declines
+            else:
+                pos = i
+                break
+        if pos < 0:
+            return self._pick_primary(up)
+        primary = up[pos]
+        if pool.can_shift_osds() and pos > 0:
+            for i in range(pos, 0, -1):
+                up[i] = up[i - 1]
+            up[0] = primary
+        return primary
+
+    def _get_temp_osds(
+        self, pool: Pool, pgid: tuple[int, int]
+    ) -> tuple[list[int], int]:
+        """OSDMap::_get_temp_osds: the pg_temp acting override with
+        down members dropped (replicated) or holed (EC), and the
+        primary_temp / first-live-temp primary."""
+        temp = []
+        for o in self.pg_temp.get(pgid, ()):  # absent -> empty
+            if not self.is_up(o):
+                if pool.can_shift_osds():
+                    continue
+                temp.append(ITEM_NONE)
+            else:
+                temp.append(o)
+        primary = self.primary_temp.get(pgid, -1)
+        if primary == -1:
+            for o in temp:
+                if o != ITEM_NONE:
+                    primary = o
+                    break
+        return temp, primary
+
     def pg_to_up_acting_osds(
         self, pgid: tuple[int, int]
     ) -> tuple[list[int], int]:
-        """(up set, up primary) — the full pipeline of OSDMap.cc:2891
-        (acting == up here until temp mappings land with peering)."""
+        """(acting set, acting primary) — the membership IO targets
+        (the full pipeline of OSDMap.cc:2891: crush -> upmap -> up ->
+        affinity, with pg_temp/primary_temp overriding acting)."""
+        _up, _upp, acting, primary = self.pg_to_up_acting_full(pgid)
+        return acting, primary
+
+    def pg_to_up_acting_full(
+        self, pgid: tuple[int, int]
+    ) -> tuple[list[int], int, list[int], int]:
+        """(up, up_primary, acting, acting_primary)."""
         pool = self.pools[pgid[0]]
-        raw, _pps = self.pg_to_raw_osds(pgid)
+        raw, pps = self.pg_to_raw_osds(pgid)
         raw = self._apply_upmap(pool, pgid, raw)
         up = self._raw_to_up_osds(pool, raw)
-        return up, self._pick_primary(up)
+        up_primary = self._apply_primary_affinity(pps, pool, up)
+        acting, acting_primary = self._get_temp_osds(pool, pgid)
+        if not acting:
+            acting = up  # primary_temp still applies (reference keeps
+            # _acting_primary when set even with no pg_temp)
+        if acting_primary == -1:
+            acting_primary = up_primary
+        return up, up_primary, acting, acting_primary
 
     def object_to_up_osds(
         self, pool_id: int, name: bytes | str
@@ -300,6 +380,21 @@ class OSDMap:
                 self.pg_upmap_primaries[pgid] = prim
             else:
                 self.pg_upmap_primaries.pop(pgid, None)
+        for pgid, temp in inc.new_pg_temp.items():
+            if temp:
+                self.pg_temp[pgid] = list(temp)
+            else:
+                self.pg_temp.pop(pgid, None)
+        for pgid, prim in inc.new_primary_temp.items():
+            if prim != -1:
+                self.primary_temp[pgid] = prim
+            else:
+                self.primary_temp.pop(pgid, None)
+        for osd, aff in inc.new_primary_affinity.items():
+            if aff == 0x10000:
+                self.primary_affinity.pop(osd, None)
+            else:
+                self.primary_affinity[osd] = aff
         self._out_weights_cache = None
         self.epoch = inc.epoch
 
@@ -321,3 +416,12 @@ class Incremental:
     new_pg_upmap_primaries: dict[tuple[int, int], int | None] = field(
         default_factory=dict
     )
+    # pgid -> temp acting set ([] removes), pgid -> temp primary (-1
+    # removes), osd -> 16.16 affinity (0x10000 removes)
+    new_pg_temp: dict[tuple[int, int], list[int]] = field(
+        default_factory=dict
+    )
+    new_primary_temp: dict[tuple[int, int], int] = field(
+        default_factory=dict
+    )
+    new_primary_affinity: dict[int, int] = field(default_factory=dict)

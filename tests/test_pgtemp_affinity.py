@@ -1,0 +1,130 @@
+"""pg_temp / primary_temp / primary affinity tests (OSDMap.cc
+_get_temp_osds + _apply_primary_affinity roles)."""
+import numpy as np
+
+from ceph_tpu.placement import crushmap as cm
+from ceph_tpu.placement import encoding as menc
+from ceph_tpu.placement.osdmap import Incremental, OSDMap, Pool
+
+NONE = 0x7FFFFFFF
+
+
+def make_map(n=6, pool_type="replicated"):
+    crush = cm.build_flat(n)
+    crush.add_rule(cm.flat_firstn_rule(0))
+    crush.add_rule(cm.ec_rule(1))
+    m = OSDMap(crush, n)
+    if pool_type == "replicated":
+        m.add_pool(Pool(id=1, name="p", size=3, pg_num=16, crush_rule=0))
+    else:
+        m.add_pool(Pool(id=1, name="p", size=3, min_size=2, pg_num=16,
+                        crush_rule=1, type="erasure",
+                        ec_profile={"k": "2", "m": "1"}))
+    return m
+
+
+def test_pg_temp_overrides_acting_not_up():
+    m = make_map()
+    pgid = (1, 3)
+    up, upp, acting, actp = m.pg_to_up_acting_full(pgid)
+    assert acting == up and actp == upp
+    temp = [o for o in range(m.n_osds) if o not in up][:2]
+    m.pg_temp[pgid] = temp
+    up2, upp2, acting2, actp2 = m.pg_to_up_acting_full(pgid)
+    assert up2 == up and upp2 == upp  # up side untouched
+    assert acting2 == temp
+    assert actp2 == temp[0]
+    # the 2-tuple surface serves acting (what IO targets)
+    a, p = m.pg_to_up_acting_osds(pgid)
+    assert a == temp and p == temp[0]
+    # removing the temp restores crush placement
+    del m.pg_temp[pgid]
+    assert m.pg_to_up_acting_osds(pgid) == (up, upp)
+
+
+def test_pg_temp_drops_down_members():
+    m = make_map()
+    pgid = (1, 0)
+    m.pg_temp[pgid] = [0, 1, 2]
+    m.osds[1].up = False
+    acting, primary = m.pg_to_up_acting_osds(pgid)
+    assert acting == [0, 2]  # replicated: compacted
+    m2 = make_map(pool_type="erasure")
+    m2.pg_temp[pgid] = [0, 1, 2]
+    m2.osds[1].up = False
+    acting2, _ = m2.pg_to_up_acting_osds(pgid)
+    assert acting2 == [0, NONE, 2]  # EC: positional hole
+
+
+def test_primary_temp():
+    m = make_map()
+    pgid = (1, 5)
+    up, _ = m.pg_to_up_acting_osds(pgid)
+    m.primary_temp[pgid] = up[-1]
+    _, _, acting, primary = m.pg_to_up_acting_full(pgid)
+    assert primary == up[-1]
+    assert acting == up  # membership unchanged, only who leads
+
+
+def test_primary_affinity_shifts_leadership():
+    m = make_map(n=4)
+    # osd 0 never primary: every pg it would lead picks someone else
+    m.primary_affinity[0] = 0
+    led_by_0 = 0
+    for ps in range(16):
+        acting, primary = m.pg_to_up_acting_osds((1, ps))
+        if primary == 0:
+            led_by_0 += 1
+        # replicated pools shift the chosen primary to the front
+        assert acting[0] == primary
+        assert 0 in acting or 0 not in acting  # membership intact
+    assert led_by_0 == 0
+    # partial affinity: 0 leads a reduced share, not zero forever
+    m.primary_affinity[0] = 0x8000
+    led = sum(
+        1 for ps in range(16)
+        if m.pg_to_up_acting_osds((1, ps))[1] == 0
+    )
+    assert 0 <= led <= 8  # roughly halved from its fair share
+
+
+def test_affinity_fallback_when_all_decline():
+    m = make_map(n=3)
+    for o in range(3):
+        m.primary_affinity[o] = 0
+    for ps in range(8):
+        acting, primary = m.pg_to_up_acting_osds((1, ps))
+        assert primary in acting  # someone still leads
+
+
+def test_temp_and_affinity_ride_incrementals_and_encoding():
+    m = make_map()
+    inc = Incremental(
+        epoch=2,
+        new_pg_temp={(1, 2): [3, 4, 5]},
+        new_primary_temp={(1, 2): 4},
+        new_primary_affinity={0: 0x4000},
+    )
+    blob = menc.encode_incremental(inc)
+    inc2, used = menc.decode_incremental(blob)
+    assert used == len(blob)
+    assert inc2.new_pg_temp == inc.new_pg_temp
+    assert inc2.new_primary_temp == inc.new_primary_temp
+    assert inc2.new_primary_affinity == inc.new_primary_affinity
+    m.apply_incremental(inc2)
+    assert m.pg_to_up_acting_osds((1, 2)) == ([3, 4, 5], 4)
+    assert m.primary_affinity == {0: 0x4000}
+    # removal semantics
+    m.apply_incremental(Incremental(
+        epoch=3, new_pg_temp={(1, 2): []},
+        new_primary_temp={(1, 2): -1},
+        new_primary_affinity={0: 0x10000},
+    ))
+    assert not m.pg_temp and not m.primary_temp
+    assert not m.primary_affinity
+    # full-map round trip carries the fields
+    m.pg_temp[(1, 9)] = [1, 2, 0]
+    m.primary_affinity[2] = 0x2000
+    m2, _ = menc.decode_osdmap(menc.encode_osdmap(m))
+    assert m2.pg_temp == {(1, 9): [1, 2, 0]}
+    assert m2.primary_affinity == {2: 0x2000}
