@@ -253,6 +253,16 @@ class RadosClient:
             [M.osd_op("omap_rmkeys", keys=[bytes(k) for k in keys])],
         )
 
+    async def execute(self, pool_id: int, name, cls: str, method: str,
+                      inp: bytes = b"") -> bytes:
+        """Run a server-side object class method (rados_exec role)."""
+        reply = await self._submit(
+            pool_id, name,
+            [M.osd_op("call", key=f"{cls}.{method}".encode(),
+                      data=bytes(inp))],
+        )
+        return reply.outs[0][1]
+
 
 class ObjectOperation:
     """Compound-op builder (ObjectWriteOperation/ObjectReadOperation
@@ -326,3 +336,9 @@ class ObjectOperation:
 
     def omap_get_keys(self):
         return self._add("omap_getkeys")
+
+    def call(self, cls: str, method: str, inp: bytes = b""):
+        """Server-side class method inside the compound op
+        (ObjectOperation::exec role)."""
+        return self._add("call", key=f"{cls}.{method}".encode(),
+                         data=bytes(inp))

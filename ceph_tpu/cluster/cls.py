@@ -1,0 +1,289 @@
+"""cls object classes: server-side stored procedures executed inside
+the OSD's op vector (the src/objclass + src/cls + osd/ClassHandler
+roles).
+
+A class method registers as (cls, method, flags) -> handler(ctx, in) ->
+out bytes; clients invoke it with the "call" op. The handler sees the
+object through ClsContext — the objclass API surface (read/write/
+xattr/omap/exists) — against the op vector's working state, so class
+mutations commit atomically with the rest of the vector and read ops
+inside the vector observe them.
+
+Built-in classes mirror the reference's most-used ones:
+- ``lock``: advisory object locks (cls_lock role) — exclusive/shared
+  with owner+cookie, lock/unlock/break_lock/get_info.
+- ``refcount``: tag-based reference counting (cls_refcount role) —
+  get/put, object removal when the last tag drops.
+- ``version``: per-object version counter with compare gates
+  (cls_version role).
+"""
+from __future__ import annotations
+
+from ..utils import denc
+
+RD = 1
+WR = 2
+
+
+class ClsError(Exception):
+    def __init__(self, code: int, what: str = ""):
+        super().__init__(what or str(code))
+        self.code = code
+
+
+_EBUSY = -16
+_ENOENT = -2
+_EINVAL = -22
+_ECANCELED = -125
+
+_REGISTRY: dict[tuple[str, str], tuple] = {}
+
+
+def register(cls: str, method: str, flags: int):
+    """@register("lock", "lock", RD | WR) — the cls_register_cxx_method
+    role."""
+
+    def deco(fn):
+        _REGISTRY[(cls, method)] = (fn, flags)
+        return fn
+
+    return deco
+
+
+def lookup(cls: str, method: str):
+    return _REGISTRY.get((cls, method))
+
+
+def methods() -> list[str]:
+    return sorted(f"{c}.{m}" for c, m in _REGISTRY)
+
+
+class ClsContext:
+    """objclass API over the op vector's working object state."""
+
+    def __init__(self, state: dict, exists: bool):
+        self._state = state
+        self.exists = exists
+        self.mutated = False
+        self.removed = False
+
+    # -------------------------------------------------------- data ops
+
+    def read(self, offset: int = 0, length: int = -1) -> bytes:
+        data = self._state["data"]
+        if length < 0:
+            return bytes(data[offset:])
+        return bytes(data[offset : offset + length])
+
+    def write_full(self, data: bytes) -> None:
+        self._state["data"][:] = data
+        self.mutated = True
+
+    def remove(self) -> None:
+        self.removed = True
+        self.mutated = True
+
+    def stat(self) -> int:
+        return len(self._state["data"])
+
+    # ------------------------------------------------------- xattr ops
+
+    def getxattr(self, key: str) -> bytes | None:
+        return self._state["xattrs"].get(key)
+
+    def setxattr(self, key: str, value: bytes) -> None:
+        self._state["xattrs"][key] = bytes(value)
+        self.mutated = True
+
+    def rmxattr(self, key: str) -> None:
+        self._state["xattrs"].pop(key, None)
+        self.mutated = True
+
+    # -------------------------------------------------------- omap ops
+
+    def omap_get(self, key: bytes) -> bytes | None:
+        return self._state["omap"].get(bytes(key))
+
+    def omap_set(self, key: bytes, value: bytes) -> None:
+        self._state["omap"][bytes(key)] = bytes(value)
+        self.mutated = True
+
+    def omap_rm(self, key: bytes) -> None:
+        self._state["omap"].pop(bytes(key), None)
+        self.mutated = True
+
+    def omap_keys(self) -> list[bytes]:
+        return sorted(self._state["omap"])
+
+
+# ===================================================== built-in: lock
+
+
+def _lock_attr(name: str) -> str:
+    return f"lock.{name}"
+
+
+def _enc_lock(ltype: str, holders: list[tuple[str, str]]) -> bytes:
+    return denc.enc_str(ltype) + denc.enc_list(
+        holders,
+        lambda h: denc.enc_str(h[0]) + denc.enc_str(h[1]),
+    )
+
+
+def _dec_lock(b: bytes):
+    ltype, off = denc.dec_str(b, 0)
+
+    def one(buf, o):
+        owner, o = denc.dec_str(buf, o)
+        cookie, o = denc.dec_str(buf, o)
+        return (owner, cookie), o
+
+    holders, _ = denc.dec_list(b, off, one)
+    return ltype, holders
+
+
+@register("lock", "lock", RD | WR)
+def lock_lock(ctx: ClsContext, inp: bytes) -> bytes:
+    """input: name, type("exclusive"|"shared"), owner, cookie."""
+    name, off = denc.dec_str(inp, 0)
+    ltype, off = denc.dec_str(inp, off)
+    owner, off = denc.dec_str(inp, off)
+    cookie, _ = denc.dec_str(inp, off)
+    if ltype not in ("exclusive", "shared"):
+        raise ClsError(_EINVAL, f"lock type {ltype!r}")
+    raw = ctx.getxattr(_lock_attr(name))
+    if raw is None:
+        ctx.setxattr(_lock_attr(name), _enc_lock(ltype, [(owner, cookie)]))
+        return b""
+    cur_type, holders = _dec_lock(raw)
+    if (owner, cookie) in holders:
+        return b""  # re-entrant grant
+    if cur_type == "exclusive" or ltype == "exclusive":
+        raise ClsError(_EBUSY, f"lock {name} held")
+    holders.append((owner, cookie))
+    ctx.setxattr(_lock_attr(name), _enc_lock(cur_type, holders))
+    return b""
+
+
+@register("lock", "unlock", RD | WR)
+def lock_unlock(ctx: ClsContext, inp: bytes) -> bytes:
+    name, off = denc.dec_str(inp, 0)
+    owner, off = denc.dec_str(inp, off)
+    cookie, _ = denc.dec_str(inp, off)
+    raw = ctx.getxattr(_lock_attr(name))
+    if raw is None:
+        raise ClsError(_ENOENT, f"lock {name}")
+    ltype, holders = _dec_lock(raw)
+    if (owner, cookie) not in holders:
+        raise ClsError(_ENOENT, f"{owner}/{cookie} does not hold {name}")
+    holders.remove((owner, cookie))
+    if holders:
+        ctx.setxattr(_lock_attr(name), _enc_lock(ltype, holders))
+    else:
+        ctx.rmxattr(_lock_attr(name))
+    return b""
+
+
+@register("lock", "break_lock", RD | WR)
+def lock_break(ctx: ClsContext, inp: bytes) -> bytes:
+    name, off = denc.dec_str(inp, 0)
+    owner, _ = denc.dec_str(inp, off)
+    raw = ctx.getxattr(_lock_attr(name))
+    if raw is None:
+        raise ClsError(_ENOENT, f"lock {name}")
+    ltype, holders = _dec_lock(raw)
+    keep = [h for h in holders if h[0] != owner]
+    if len(keep) == len(holders):
+        raise ClsError(_ENOENT, f"{owner} holds nothing on {name}")
+    if keep:
+        ctx.setxattr(_lock_attr(name), _enc_lock(ltype, keep))
+    else:
+        ctx.rmxattr(_lock_attr(name))
+    return b""
+
+
+@register("lock", "get_info", RD)
+def lock_get_info(ctx: ClsContext, inp: bytes) -> bytes:
+    name, _ = denc.dec_str(inp, 0)
+    raw = ctx.getxattr(_lock_attr(name))
+    return raw if raw is not None else _enc_lock("none", [])
+
+
+# ================================================= built-in: refcount
+
+
+_REF_ATTR = "refcount"
+
+
+@register("refcount", "get", RD | WR)
+def refcount_get(ctx: ClsContext, inp: bytes) -> bytes:
+    tag, _ = denc.dec_str(inp, 0)
+    raw = ctx.getxattr(_REF_ATTR) or denc.enc_list([], denc.enc_str)
+    tags, _ = denc.dec_list(raw, 0, denc.dec_str)
+    if tag not in tags:
+        tags.append(tag)
+        ctx.setxattr(_REF_ATTR, denc.enc_list(tags, denc.enc_str))
+    return b""
+
+
+@register("refcount", "put", RD | WR)
+def refcount_put(ctx: ClsContext, inp: bytes) -> bytes:
+    tag, _ = denc.dec_str(inp, 0)
+    raw = ctx.getxattr(_REF_ATTR)
+    if raw is None:
+        # untagged object: a put removes it (reference behavior for
+        # the implicit ref)
+        ctx.remove()
+        return b""
+    tags, _ = denc.dec_list(raw, 0, denc.dec_str)
+    if tag not in tags:
+        raise ClsError(_ENOENT, f"tag {tag!r}")
+    tags.remove(tag)
+    if tags:
+        ctx.setxattr(_REF_ATTR, denc.enc_list(tags, denc.enc_str))
+    else:
+        ctx.remove()  # last reference dropped
+    return b""
+
+
+@register("refcount", "read", RD)
+def refcount_read(ctx: ClsContext, inp: bytes) -> bytes:
+    raw = ctx.getxattr(_REF_ATTR) or denc.enc_list([], denc.enc_str)
+    return raw
+
+
+# ================================================== built-in: version
+
+
+_VER_ATTR = "objver"
+
+
+@register("version", "set", RD | WR)
+def version_set(ctx: ClsContext, inp: bytes) -> bytes:
+    ver, _ = denc.dec_u64(inp, 0)
+    ctx.setxattr(_VER_ATTR, denc.enc_u64(ver))
+    return b""
+
+
+@register("version", "inc", RD | WR)
+def version_inc(ctx: ClsContext, inp: bytes) -> bytes:
+    raw = ctx.getxattr(_VER_ATTR)
+    cur = denc.dec_u64(raw, 0)[0] if raw else 0
+    ctx.setxattr(_VER_ATTR, denc.enc_u64(cur + 1))
+    return b""
+
+
+@register("version", "read", RD)
+def version_read(ctx: ClsContext, inp: bytes) -> bytes:
+    raw = ctx.getxattr(_VER_ATTR)
+    return raw if raw is not None else denc.enc_u64(0)
+
+
+@register("version", "check_eq", RD)
+def version_check_eq(ctx: ClsContext, inp: bytes) -> bytes:
+    want, _ = denc.dec_u64(inp, 0)
+    raw = ctx.getxattr(_VER_ATTR)
+    cur = denc.dec_u64(raw, 0)[0] if raw else 0
+    if cur != want:
+        raise ClsError(_ECANCELED, f"version {cur} != {want}")
+    return b""

@@ -228,7 +228,9 @@ class PG:
             return
         perf = self.osd.perf
         perf.inc("op")
-        write_class = any(o[0] in WRITE_OPS for o in m.ops)
+        # cls calls may mutate: treat them as write-class for locking
+        write_class = any(o[0] in WRITE_OPS or o[0] == "call"
+                          for o in m.ops)
         perf.inc("op_w" if write_class else "op_r")
         t0 = time.perf_counter()
         try:
@@ -357,6 +359,30 @@ class PG:
                 self._check_omap()
                 state["omap"].clear()
                 state["omap_header"] = b""
+            elif op == "call":
+                # server-side object class method (objclass exec role)
+                from . import cls as cls_mod
+
+                try:
+                    clsname, method = key.decode().split(".", 1)
+                except ValueError:
+                    raise OpError(EOPNOTSUPP, f"bad call {key!r}") \
+                        from None
+                entry = cls_mod.lookup(clsname, method)
+                if entry is None:
+                    raise OpError(
+                        EOPNOTSUPP, f"no class method {key.decode()!r}"
+                    )
+                fn, _flags = entry
+                ctx = cls_mod.ClsContext(state, exists0 or mutated)
+                try:
+                    out = fn(ctx, payload)
+                except cls_mod.ClsError as e:
+                    raise OpError(e.code, str(e)) from None
+                if ctx.mutated:
+                    mutated = True
+                if ctx.removed:
+                    deleted = True
             else:
                 raise OpError(EOPNOTSUPP, f"op {op!r}")
             outs.append((M.OK, out))
