@@ -68,13 +68,25 @@ class RadosClient:
 
     def _apply_map(self, msg: M.MOSDMapMsg) -> None:
         if msg.full:
-            self.osdmap, _ = menc.decode_osdmap(msg.full)
+            full, _ = menc.decode_osdmap(msg.full)
+            if self.osdmap is None or full.epoch >= self.osdmap.epoch:
+                self.osdmap = full  # never regress to an older map
+        gapped = False
         for raw in msg.incrementals:
             inc, _ = menc.decode_incremental(raw)
             if self.osdmap is None:
                 return
             if inc.epoch == self.osdmap.epoch + 1:
                 self.osdmap.apply_incremental(inc)
+            elif inc.epoch > self.osdmap.epoch + 1:
+                gapped = True
+        if gapped:
+            # missed epochs (e.g. a mon failover moved the subscriber
+            # set): ask for a fill
+            asyncio.get_running_loop().create_task(
+                self.bus.send(self.name, "mon",
+                              M.MMonGetMap(have=self.osdmap.epoch))
+            )
         for fut in self._map_waiters:
             if not fut.done():
                 fut.set_result(None)
@@ -129,8 +141,30 @@ class RadosClient:
         except Exception:
             pass  # wait for a map change to resend
 
+    async def _wait_pool(self, pool_id: int) -> None:
+        """The map may lag (a mon failover moves the subscriber set):
+        fetch until the pool appears — the Objecter's maps-on-demand
+        stance — rather than failing on a stale map."""
+        deadline = asyncio.get_running_loop().time() + self.op_timeout
+        while (self.osdmap is None
+               or pool_id not in self.osdmap.pools):
+            if asyncio.get_running_loop().time() > deadline:
+                raise KeyError(f"pool {pool_id} not in map")
+            try:
+                await self.bus.send(
+                    self.name, "mon",
+                    M.MMonGetMap(
+                        have=self.osdmap.epoch if self.osdmap else 0
+                    ),
+                )
+            except Exception:
+                pass
+            await asyncio.sleep(0.05)
+
     async def _submit(self, pool_id: int, name: str | bytes,
                       ops: list[tuple]) -> M.MOSDOpReply:
+        if self.osdmap is None or pool_id not in self.osdmap.pools:
+            await self._wait_pool(pool_id)
         oid = name.encode() if isinstance(name, str) else bytes(name)
         pgid = self.osdmap.object_to_pg(pool_id, oid)
         self._tid += 1

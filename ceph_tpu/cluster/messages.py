@@ -304,6 +304,71 @@ class MPGScanReply(Message):
               ("objects", "map:bytes:" + EVERSION))
 
 
+# ------------------------------------------------------------ mon <-> mon
+
+
+@register_message
+class MMonElect(Message):
+    TYPE = 70
+    # propose myself (rank) for election epoch (Elector propose role)
+    FIELDS = (("epoch", "u32"), ("rank", "u32"))
+
+
+@register_message
+class MMonElectAck(Message):
+    TYPE = 71
+    FIELDS = (("epoch", "u32"), ("rank", "u32"))  # rank = supporter
+
+
+@register_message
+class MMonVictory(Message):
+    TYPE = 72
+    FIELDS = (("epoch", "u32"), ("leader", "u32"),
+              ("quorum", "list:u32"))
+
+
+@register_message
+class MMonLease(Message):
+    TYPE = 73
+    # leader heartbeat extending its authority (Paxos lease role)
+    FIELDS = (("epoch", "u32"), ("leader", "u32"),
+              ("last_committed", "u32"))
+
+
+@register_message
+class MPaxosCollect(Message):
+    TYPE = 74
+    # new leader recovering state (Paxos::collect role)
+    FIELDS = (("pn", "u64"), ("epoch", "u32"))
+
+
+@register_message
+class MPaxosLast(Message):
+    TYPE = 75
+    FIELDS = (("pn", "u64"), ("rank", "u32"), ("last_committed", "u32"),
+              ("uncommitted_pn", "u64"), ("uncommitted_ver", "u32"),
+              ("uncommitted_value", "bytes"))
+
+
+@register_message
+class MPaxosBegin(Message):
+    TYPE = 76
+    # value = encoded Incremental for version (Paxos::begin role)
+    FIELDS = (("pn", "u64"), ("version", "u32"), ("value", "bytes"))
+
+
+@register_message
+class MPaxosAccept(Message):
+    TYPE = 77
+    FIELDS = (("pn", "u64"), ("version", "u32"), ("rank", "u32"))
+
+
+@register_message
+class MPaxosCommit(Message):
+    TYPE = 78
+    FIELDS = (("version", "u32"), ("value", "bytes"))
+
+
 # -------------------------------------------------------------------- mgr
 
 

@@ -1,0 +1,454 @@
+"""PaxosMon: replicated monitors with leader election and two-phase
+map commits (the src/mon/Paxos.cc:154-890 + Elector roles).
+
+N mons (``mon.0`` .. ``mon.N-1``) form a quorum. The Elector is
+rank-based like the reference's classic mode: a mon proposes itself
+for an election epoch; peers ack unless a lower rank is in the race
+(they counter-propose); majority acks -> victory, broadcast with the
+quorum. The winner claims the public ``mon`` bus name, so OSDs and
+clients keep talking to "the mon" with no routing changes; leases
+(MMonLease) extend its authority and a missed lease triggers a new
+election.
+
+Map mutations run the Paxos value path compressed to its load-bearing
+arc (collect :154 / begin :613 / accept :772 / commit :847-890):
+
+- On victory the leader collects peers' last_committed and any
+  uncommitted (pn, version, value), re-proposes the highest-pn
+  uncommitted value first (the recovery obligation), and back-fills
+  lagging peers from history.
+- commit(inc) = begin: broadcast (pn, version, value) to the quorum,
+  wait for MAJORITY accepts (counting itself), then apply + publish
+  locally and send MPaxosCommit to peers, which apply the incremental
+  to their own replicas. No quorum majority -> the round times out and
+  the mutation fails (writes to the cluster map stall, the CP choice
+  the reference makes).
+
+Single-mon clusters short-circuit to local commits (quorum of one).
+"""
+from __future__ import annotations
+
+import asyncio
+import time
+
+from ..placement import crushmap as cm
+from ..placement import encoding as menc
+from ..placement.osdmap import Incremental
+from . import messages as M
+from .mon import MonLite
+
+
+class QuorumLost(Exception):
+    pass
+
+
+class PaxosMon(MonLite):
+    def __init__(self, bus, n_osds: int, rank: int, n_mons: int,
+                 crush: cm.CrushMap | None = None,
+                 hb_grace: float = 1.0, out_interval: float = 5.0,
+                 lease_interval: float = 0.3,
+                 election_timeout: float = 1.0,
+                 accept_timeout: float = 2.0):
+        super().__init__(bus, n_osds, crush=crush, hb_grace=hb_grace,
+                         out_interval=out_interval, name=f"mon.{rank}")
+        self.rank = rank
+        self.n_mons = n_mons
+        self.lease_interval = lease_interval
+        self.election_timeout = election_timeout
+        self.accept_timeout = accept_timeout
+        # election state
+        self.election_epoch = 0
+        self.leader: int | None = None
+        self.quorum: set[int] = set()
+        self._acks: set[int] = set()
+        self._last_lease = 0.0
+        self._electing = False
+        # paxos state
+        self.pn = 100 + rank  # proposal numbers disjoint per rank
+        self.promised_pn = 0
+        self.accepted_pn = 0
+        self.uncommitted: tuple[int, int, bytes] | None = None
+        self._accept_waits: dict[tuple[int, int], set[int]] = {}
+        self._accept_futs: dict[tuple[int, int], asyncio.Future] = {}
+        self._collect_replies: dict[int, M.MPaxosLast] = {}
+        self._collect_fut: asyncio.Future | None = None
+        self._lease_task: asyncio.Task | None = None
+        self._elect_task: asyncio.Task | None = None
+        self._commit_lock = asyncio.Lock()
+
+    # ---------------------------------------------------------- lifecycle
+
+    @property
+    def majority(self) -> int:
+        return self.n_mons // 2 + 1
+
+    def is_leader(self) -> bool:
+        return self.leader == self.rank
+
+    def peers(self) -> list[int]:
+        return [r for r in range(self.n_mons) if r != self.rank]
+
+    async def start(self) -> None:
+        self.bus.register(self.name, self.handle)
+        self._watchdog = asyncio.get_running_loop().create_task(
+            self._watch_loop()
+        )
+        self._elect_task = asyncio.get_running_loop().create_task(
+            self._election_loop()
+        )
+
+    async def stop(self) -> None:
+        for t in (self._lease_task, self._elect_task):
+            if t:
+                t.cancel()
+        if self.is_leader():
+            try:
+                self.bus.unregister("mon")
+            except Exception:
+                pass
+        await super().stop()
+
+    # ----------------------------------------------------------- election
+
+    async def _election_loop(self) -> None:
+        await asyncio.sleep(0.01 * self.rank)  # stagger startup
+        while True:
+            try:
+                now = time.monotonic()
+                stale = (now - self._last_lease) > self.election_timeout
+                if self.leader is None or (
+                    not self.is_leader() and stale
+                ):
+                    await self._start_election()
+                await asyncio.sleep(self.lease_interval)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                pass
+
+    async def _start_election(self) -> None:
+        if self.n_mons == 1:
+            self._become_leader({self.rank})
+            return
+        # depose the stale leader (possibly ourselves) for this round
+        if self.is_leader():
+            try:
+                self.bus.unregister("mon")
+            except Exception:
+                pass
+        self.leader = None
+        self.election_epoch += 1
+        epoch = self.election_epoch
+        self._acks = {self.rank}
+        self._electing = True
+        try:
+            for r in self.peers():
+                try:
+                    await self.bus.send(
+                        self.name, f"mon.{r}",
+                        M.MMonElect(epoch=epoch, rank=self.rank),
+                    )
+                except Exception:
+                    pass
+            await asyncio.sleep(self.election_timeout / 2)
+            if (self.election_epoch == epoch
+                    and len(self._acks) >= self.majority
+                    and self.leader is None):
+                self._become_leader(set(self._acks))
+                for r in self.peers():
+                    try:
+                        await self.bus.send(
+                            self.name, f"mon.{r}",
+                            M.MMonVictory(epoch=epoch, leader=self.rank,
+                                          quorum=sorted(self._acks)),
+                        )
+                    except Exception:
+                        pass
+                await self._leader_collect()
+        finally:
+            self._electing = False
+
+    def _become_leader(self, quorum: set[int]) -> None:
+        self.leader = self.rank
+        self.quorum = quorum
+        self._last_lease = time.monotonic()
+        # expect heartbeats from every up OSD from NOW: one that died
+        # during the failover never pings the new leader, yet must
+        # still trip the watchdog
+        now = time.monotonic()
+        for osd in range(self.osdmap.n_osds):
+            if self.osdmap.osds[osd].up:
+                self.last_ping.setdefault(osd, now)
+        # claim the public name: clients/OSDs talk to "the mon"
+        self.bus.register("mon", self.handle)
+        if self._lease_task is None or self._lease_task.done():
+            self._lease_task = asyncio.get_running_loop().create_task(
+                self._lease_loop()
+            )
+
+    async def _lease_loop(self) -> None:
+        while self.is_leader():
+            for r in self.peers():
+                try:
+                    await self.bus.send(
+                        self.name, f"mon.{r}",
+                        M.MMonLease(epoch=self.election_epoch,
+                                    leader=self.rank,
+                                    last_committed=self.osdmap.epoch),
+                    )
+                except Exception:
+                    pass
+            await asyncio.sleep(self.lease_interval)
+
+    async def _leader_collect(self) -> None:
+        """Paxos::collect — recover uncommitted state from the quorum
+        and back-fill lagging peers."""
+        self.pn += self.n_mons  # fresh, globally unique pn
+        self._collect_replies = {}
+        self._collect_fut = asyncio.get_running_loop().create_future()
+        for r in self.peers():
+            try:
+                await self.bus.send(
+                    self.name, f"mon.{r}",
+                    M.MPaxosCollect(pn=self.pn,
+                                    epoch=self.election_epoch),
+                )
+            except Exception:
+                pass
+        try:
+            await asyncio.wait_for(self._collect_fut,
+                                   self.accept_timeout)
+        except asyncio.TimeoutError:
+            pass
+        best = self.uncommitted
+        for rep in self._collect_replies.values():
+            if rep.uncommitted_ver and (
+                best is None or rep.uncommitted_pn > best[0]
+            ):
+                best = (rep.uncommitted_pn, rep.uncommitted_ver,
+                        rep.uncommitted_value)
+            # back-fill peers that are behind
+        for r, rep in self._collect_replies.items():
+            for e in range(rep.last_committed + 1,
+                           self.osdmap.epoch + 1):
+                if e in self.history:
+                    try:
+                        await self.bus.send(
+                            self.name, f"mon.{r}",
+                            M.MPaxosCommit(version=e,
+                                           value=self.history[e]),
+                        )
+                    except Exception:
+                        pass
+        if best is not None and best[1] == self.osdmap.epoch + 1:
+            # recovery obligation: finish the in-flight round
+            inc, _ = menc.decode_incremental(best[2])
+            self.uncommitted = None
+            try:
+                await self.commit(inc)
+            except QuorumLost:
+                pass
+
+    # ------------------------------------------------------------- dispatch
+
+    async def handle(self, src: str, msg) -> None:
+        if isinstance(msg, M.MMonElect):
+            await self._handle_elect(src, msg)
+        elif isinstance(msg, M.MMonElectAck):
+            if msg.epoch == self.election_epoch:
+                self._acks.add(msg.rank)
+        elif isinstance(msg, M.MMonVictory):
+            self._handle_victory(msg)
+        elif isinstance(msg, M.MMonLease):
+            self._handle_lease(msg)
+        elif isinstance(msg, M.MPaxosCollect):
+            await self._handle_collect(src, msg)
+        elif isinstance(msg, M.MPaxosLast):
+            self._handle_last(msg)
+        elif isinstance(msg, M.MPaxosBegin):
+            await self._handle_begin(src, msg)
+        elif isinstance(msg, M.MPaxosAccept):
+            self._handle_accept(msg)
+        elif isinstance(msg, M.MPaxosCommit):
+            self._handle_commit(msg)
+        elif isinstance(msg, M.MOSDMapMsg):
+            # follower catch-up: apply the leader's map publication
+            for raw in msg.incrementals:
+                inc, _ = menc.decode_incremental(raw)
+                if inc.epoch == self.osdmap.epoch + 1:
+                    self.history[inc.epoch] = raw
+                    self.osdmap.apply_incremental(inc)
+            if msg.full and self.osdmap.epoch < msg.epoch:
+                m, _ = menc.decode_osdmap(msg.full)
+                self.osdmap = m
+        elif isinstance(msg, M.MPing):
+            self.subscribers.add(src)
+            await super().handle(src, msg)
+        elif isinstance(msg, M.MMonGetMap):
+            self.subscribers.add(src)
+            await super().handle(src, msg)
+        else:
+            await super().handle(src, msg)
+
+    async def _handle_elect(self, src: str, msg: M.MMonElect) -> None:
+        if msg.rank < self.rank:
+            # support the better candidate, drop any claim of our own,
+            # and DEFER: stop proposing while their round completes
+            # (the Elector defer role — without it a higher rank's
+            # periodic proposals livelock the lower rank's election)
+            if msg.epoch > self.election_epoch or (
+                self.leader is None or self.leader >= msg.rank
+            ):
+                self.election_epoch = max(self.election_epoch, msg.epoch)
+                if self.is_leader():
+                    try:
+                        self.bus.unregister("mon")
+                    except Exception:
+                        pass
+                self.leader = None
+                self._last_lease = time.monotonic()  # defer window
+                await self.bus.send(
+                    self.name, src,
+                    M.MMonElectAck(epoch=msg.epoch, rank=self.rank),
+                )
+        elif not self._electing:
+            # a lower rank (us) should lead: counter-propose, unless a
+            # round of ours is already in flight
+            await self._start_election()
+
+    def _handle_victory(self, msg: M.MMonVictory) -> None:
+        if msg.leader < self.rank or msg.epoch >= self.election_epoch:
+            if self.is_leader() and msg.leader != self.rank:
+                try:
+                    self.bus.unregister("mon")
+                except Exception:
+                    pass
+            self.election_epoch = max(self.election_epoch, msg.epoch)
+            self.leader = msg.leader
+            self.quorum = set(msg.quorum)
+            self._last_lease = time.monotonic()
+
+    def _handle_lease(self, msg: M.MMonLease) -> None:
+        if msg.leader == self.leader:
+            self._last_lease = time.monotonic()
+
+    async def _handle_collect(self, src: str, msg: M.MPaxosCollect) -> None:
+        if msg.pn > self.promised_pn:
+            self.promised_pn = msg.pn
+        un = self.uncommitted
+        await self.bus.send(
+            self.name, src,
+            M.MPaxosLast(
+                pn=msg.pn, rank=self.rank,
+                last_committed=self.osdmap.epoch,
+                uncommitted_pn=un[0] if un else 0,
+                uncommitted_ver=un[1] if un else 0,
+                uncommitted_value=un[2] if un else b"",
+            ),
+        )
+
+    def _handle_last(self, msg: M.MPaxosLast) -> None:
+        if msg.pn == self.pn:
+            self._collect_replies[msg.rank] = msg
+            if (len(self._collect_replies) >= len(self.peers())
+                    and self._collect_fut
+                    and not self._collect_fut.done()):
+                self._collect_fut.set_result(None)
+
+    async def _handle_begin(self, src: str, msg: M.MPaxosBegin) -> None:
+        if msg.pn < self.promised_pn:
+            return  # promised a newer leader; stay silent
+        self.promised_pn = msg.pn
+        self.accepted_pn = msg.pn
+        self.uncommitted = (msg.pn, msg.version, msg.value)
+        await self.bus.send(
+            self.name, src,
+            M.MPaxosAccept(pn=msg.pn, version=msg.version,
+                           rank=self.rank),
+        )
+
+    def _handle_accept(self, msg: M.MPaxosAccept) -> None:
+        key = (msg.pn, msg.version)
+        self._accept_waits.setdefault(key, set()).add(msg.rank)
+        fut = self._accept_futs.get(key)
+        if (fut and not fut.done()
+                and len(self._accept_waits[key]) + 1 >= self.majority):
+            fut.set_result(None)
+
+    def _handle_commit(self, msg: M.MPaxosCommit) -> None:
+        """Follower-side apply (Paxos::handle_commit role)."""
+        if msg.version <= self.osdmap.epoch:
+            return  # stale
+        if msg.version > self.osdmap.epoch + 1:
+            # gapped (e.g. a revived replica): pull history from the
+            # current leader via the public name
+            asyncio.get_running_loop().create_task(
+                self._request_catchup()
+            )
+            return
+        inc, _ = menc.decode_incremental(msg.value)
+        self.history[msg.version] = msg.value
+        self.osdmap.apply_incremental(inc)
+        if self.uncommitted and self.uncommitted[1] <= msg.version:
+            self.uncommitted = None
+
+    async def _request_catchup(self) -> None:
+        try:
+            await self.bus.send(
+                self.name, "mon",
+                M.MMonGetMap(have=self.osdmap.epoch),
+            )
+        except Exception:
+            pass
+
+    # ------------------------------------------------------------- commit
+
+    async def commit(self, inc: Incremental) -> None:
+        """Leader-side Paxos round, then the base publish path."""
+        async with self._commit_lock:
+            if inc.epoch != self.osdmap.epoch + 1:
+                # a concurrent commit advanced the map; rebase
+                inc.epoch = self.osdmap.epoch + 1
+            if self.n_mons > 1:
+                if not self.is_leader():
+                    raise QuorumLost("not the leader")
+                value = menc.encode_incremental(inc)
+                key = (self.pn, inc.epoch)
+                fut = asyncio.get_running_loop().create_future()
+                self._accept_futs[key] = fut
+                self._accept_waits.setdefault(key, set())
+                self.uncommitted = (self.pn, inc.epoch, value)
+                for r in self.peers():
+                    try:
+                        await self.bus.send(
+                            self.name, f"mon.{r}",
+                            M.MPaxosBegin(pn=self.pn, version=inc.epoch,
+                                          value=value),
+                        )
+                    except Exception:
+                        pass
+                if self.majority > 1:
+                    try:
+                        await asyncio.wait_for(fut, self.accept_timeout)
+                    except asyncio.TimeoutError:
+                        self._accept_futs.pop(key, None)
+                        raise QuorumLost(
+                            f"no majority for epoch {inc.epoch}"
+                        ) from None
+                self._accept_futs.pop(key, None)
+                accepted_by = self._accept_waits.pop(key, set())
+                self.uncommitted = None
+                await super().commit(inc)
+                value = self.history[inc.epoch]
+                for r in self.peers():
+                    try:
+                        await self.bus.send(
+                            self.name, f"mon.{r}",
+                            M.MPaxosCommit(version=inc.epoch,
+                                           value=value),
+                        )
+                    except Exception:
+                        pass
+                del accepted_by
+            else:
+                await super().commit(inc)

@@ -19,26 +19,81 @@ from .mon import MonLite
 from .osd import OSDLite
 
 
+class _LeaderRef:
+    """Late-bound view of the current mon leader (the mgr keeps
+    reading the authoritative map across failovers)."""
+
+    def __init__(self, cluster: "TestCluster"):
+        self._c = cluster
+
+    @property
+    def osdmap(self):
+        return self._c.mon.osdmap
+
+
 class TestCluster:
     def __init__(self, n_osds: int = 5, hb_grace: float = 2.0,
                  out_interval: float = 4.0, hb_interval: float = 0.15,
-                 crush: cm.CrushMap | None = None):
+                 crush: cm.CrushMap | None = None, n_mons: int = 1):
         self.bus = LocalBus()
         self.n_osds = n_osds
-        self.mon = MonLite(self.bus, n_osds, crush=crush,
-                           hb_grace=hb_grace, out_interval=out_interval)
+        self.n_mons = n_mons
+        if n_mons > 1:
+            from .paxos_mon import PaxosMon
+
+            self.mons: list = [
+                PaxosMon(self.bus, n_osds, rank=r, n_mons=n_mons,
+                         crush=crush, hb_grace=hb_grace,
+                         out_interval=out_interval)
+                for r in range(n_mons)
+            ]
+            self._mon = None
+        else:
+            self.mons = []
+            self._mon = MonLite(self.bus, n_osds, crush=crush,
+                                hb_grace=hb_grace,
+                                out_interval=out_interval)
         self.stores = [MemStore() for _ in range(n_osds)]
         self.osds: list[OSDLite | None] = [None] * n_osds
         self.hb_interval = hb_interval
-        self.mgr = MgrLite(self.bus, self.mon)
+        self.mgr = MgrLite(self.bus, _LeaderRef(self))
         self.client = RadosClient(self.bus)
 
+    @property
+    def mon(self):
+        """The authoritative mon: the single one, or the quorum
+        leader (falling back to any live replica)."""
+        if self._mon is not None:
+            return self._mon
+        for m in self.mons:
+            if m is not None and m.is_leader():
+                return m
+        return next(m for m in self.mons if m is not None)
+
     async def start(self) -> None:
-        await self.mon.start()
+        if self._mon is not None:
+            await self._mon.start()
+        else:
+            for m in self.mons:
+                await m.start()
+            await self.wait_quorum()
         await self.mgr.start()
         for i in range(self.n_osds):
             await self.start_osd(i)
         await self.client.connect()
+
+    async def wait_quorum(self, timeout: float = 10.0) -> None:
+        async def _wait():
+            while not any(m is not None and m.is_leader()
+                          for m in self.mons):
+                await asyncio.sleep(0.02)
+        await asyncio.wait_for(_wait(), timeout)
+
+    async def kill_mon(self, rank: int) -> None:
+        m = self.mons[rank]
+        if m is not None:
+            await m.stop()
+            self.mons[rank] = None
 
     async def stop(self) -> None:
         await self.client.close()
@@ -47,7 +102,11 @@ class TestCluster:
                 await osd.stop()
                 self.osds[i] = None
         await self.mgr.stop()
-        await self.mon.stop()
+        if self._mon is not None:
+            await self._mon.stop()
+        for m in self.mons:
+            if m is not None:
+                await m.stop()
 
     async def start_osd(self, i: int) -> OSDLite:
         osd = OSDLite(self.bus, i, store=self.stores[i],

@@ -1,0 +1,92 @@
+"""Multi-mon consensus tests: quorum formation, replicated commits,
+leader failover, quorum loss (the Paxos.cc + Elector roles)."""
+import asyncio
+
+import pytest
+
+from ceph_tpu.cluster.vstart import TestCluster
+from ceph_tpu.placement.osdmap import Pool
+
+
+def run(coro, timeout=120):
+    asyncio.run(asyncio.wait_for(coro, timeout))
+
+
+async def make(n_mons=3, n_osds=4):
+    c = TestCluster(n_osds=n_osds, n_mons=n_mons)
+    await c.start()
+    await c.client.create_pool(
+        Pool(id=1, name="p", size=3, pg_num=8, crush_rule=0)
+    )
+    await c.wait_active(20)
+    return c
+
+
+def test_quorum_forms_and_cluster_works():
+    async def t():
+        c = await make()
+        # lowest rank leads (classic elector)
+        assert c.mon.rank == 0
+        assert len(c.mon.quorum) >= 2
+        await c.client.write_full(1, "obj", b"replicated-map-data")
+        assert await c.client.read(1, "obj") == b"replicated-map-data"
+        await c.stop()
+
+    run(t())
+
+
+def test_commits_replicate_to_all_mons():
+    async def t():
+        c = await make()
+        await c.client.write_full(1, "x", b"data")
+        # drive a few epochs: kill an OSD (mark-down commits a map)
+        await c.kill_osd(3)
+        await c.wait_down(3, 20)
+        await asyncio.sleep(0.5)  # let commits fan out
+        epochs = [m.osdmap.epoch for m in c.mons if m is not None]
+        assert len(set(epochs)) == 1, f"divergent epochs {epochs}"
+        downs = [m.osdmap.osds[3].up for m in c.mons if m is not None]
+        assert not any(downs)
+        await c.stop()
+
+    run(t())
+
+
+def test_leader_failover():
+    async def t():
+        c = await make()
+        assert c.mon.rank == 0
+        epoch_before = c.mon.osdmap.epoch
+        await c.kill_mon(0)
+        # a new leader takes over and keeps serving the cluster
+        await c.wait_quorum(15)
+        assert c.mon.rank == 1
+        assert c.mon.osdmap.epoch >= epoch_before
+        # map mutations still commit: kill an OSD, map must advance
+        await c.kill_osd(2)
+        await c.wait_down(2, 25)
+        # IO keeps working under the new mon
+        await c.client.write_full(1, "after-failover", b"ok")
+        assert await c.client.read(1, "after-failover") == b"ok"
+        await c.stop()
+
+    run(t())
+
+
+def test_quorum_loss_stalls_map_mutations():
+    async def t():
+        c = await make()
+        await c.kill_mon(1)
+        await c.kill_mon(2)
+        await asyncio.sleep(0.3)
+        # 1 of 3 alive: no majority -> map mutation must fail
+        from ceph_tpu.cluster.paxos_mon import QuorumLost
+        from ceph_tpu.placement.osdmap import Incremental
+
+        leader = c.mons[0]
+        inc = Incremental(epoch=leader.osdmap.epoch + 1, down=[3])
+        with pytest.raises(QuorumLost):
+            await leader.commit(inc)
+        await c.stop()
+
+    run(t())
