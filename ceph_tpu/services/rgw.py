@@ -1,0 +1,427 @@
+"""RGW-lite: S3-role object gateway on RADOS (the src/rgw role).
+
+The storage layout mirrors the reference's shape: a root registry
+object holds the bucket set in omap; each bucket has an index object
+whose omap is the sorted key -> entry mapping (the cls_rgw bucket-index
+role: size, etag, mtime per key); object data lives in per-key RADOS
+objects, striped through RadosStriper above the threshold. Multipart
+uploads store parts as separate objects and a manifest at complete
+time (the RGW manifest role).
+
+Surface (rgw_op.cc verbs): create/delete/list buckets, put/get/head/
+delete/copy objects, ListObjects with prefix/marker/max_keys +
+lexicographic ordering straight from the omap, multipart
+initiate/upload_part/complete/abort. ETags are content MD5s
+(multipart: md5-of-md5s with the -N suffix, the S3 convention).
+
+S3Frontend (rgw_asio_frontend role) serves a minimal REST dialect of
+it over asyncio TCP: GET/PUT/HEAD/DELETE on /bucket and /bucket/key,
+ListBuckets on /, ListObjectsV2 query parameters, XML responses.
+"""
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import time
+import urllib.parse
+import xml.etree.ElementTree as ET
+
+from ..osdc.striper import FileLayout
+from ..osdc.striped_client import RadosStriper
+from ..utils import denc
+
+ROOT_OID = b".rgw.root"
+STRIPE_THRESHOLD = 1 << 22  # larger objects stripe
+
+
+class RGWError(Exception):
+    def __init__(self, code: str, status: int = 400, what: str = ""):
+        super().__init__(what or code)
+        self.code = code
+        self.status = status
+
+
+def _index_oid(bucket: str) -> bytes:
+    return f".bucket.index.{bucket}".encode()
+
+
+def _data_oid(bucket: str, key: str) -> str:
+    return f"{bucket}//{key}"
+
+
+def _enc_entry(size: int, etag: str, mtime: float,
+               multipart: bool = False) -> bytes:
+    return (denc.enc_u64(size) + denc.enc_str(etag)
+            + denc.enc_u64(int(mtime)) + denc.enc_u8(multipart))
+
+
+def _dec_entry(b: bytes) -> dict:
+    size, off = denc.dec_u64(b, 0)
+    etag, off = denc.dec_str(b, off)
+    mtime, off = denc.dec_u64(b, off)
+    multipart, _ = denc.dec_u8(b, off)
+    return {"size": size, "etag": etag, "mtime": mtime,
+            "multipart": bool(multipart)}
+
+
+class RGWLite:
+    def __init__(self, client, pool_id: int):
+        self.client = client
+        self.pool_id = pool_id
+        self.striper = RadosStriper(
+            client, pool_id,
+            FileLayout(stripe_unit=1 << 20, stripe_count=4,
+                       object_size=1 << 22),
+        )
+
+    # ------------------------------------------------------------ buckets
+
+    async def create_bucket(self, bucket: str) -> None:
+        if not bucket or "/" in bucket:
+            raise RGWError("InvalidBucketName")
+        existing = await self._buckets()
+        if bucket.encode() in existing:
+            raise RGWError("BucketAlreadyExists", 409)
+        await self.client.omap_set(
+            self.pool_id, ROOT_OID,
+            {bucket.encode(): denc.enc_u64(int(time.time()))},
+        )
+        await self.client.write_full(self.pool_id, _index_oid(bucket),
+                                     b"")
+
+    async def delete_bucket(self, bucket: str) -> None:
+        await self._require_bucket(bucket)
+        idx = await self.client.omap_get(self.pool_id,
+                                         _index_oid(bucket))
+        if idx:
+            raise RGWError("BucketNotEmpty", 409)
+        await self.client.delete(self.pool_id, _index_oid(bucket))
+        await self.client.omap_rm(self.pool_id, ROOT_OID,
+                                  [bucket.encode()])
+
+    async def list_buckets(self) -> list[str]:
+        return sorted(b.decode() for b in (await self._buckets()))
+
+    async def _buckets(self) -> dict[bytes, bytes]:
+        try:
+            return await self.client.omap_get(self.pool_id, ROOT_OID)
+        except KeyError:
+            return {}
+
+    async def _require_bucket(self, bucket: str) -> None:
+        if bucket.encode() not in await self._buckets():
+            raise RGWError("NoSuchBucket", 404)
+
+    # ------------------------------------------------------------ objects
+
+    async def put_object(self, bucket: str, key: str,
+                         data: bytes) -> str:
+        await self._require_bucket(bucket)
+        etag = hashlib.md5(data).hexdigest()
+        oid = _data_oid(bucket, key)
+        if len(data) > STRIPE_THRESHOLD:
+            await self.striper.write(oid, data)
+        else:
+            await self.striper.remove(oid)  # drop stale striped form
+            await self.client.write_full(self.pool_id, oid, data)
+        await self.client.omap_set(
+            self.pool_id, _index_oid(bucket),
+            {key.encode(): _enc_entry(len(data), etag, time.time())},
+        )
+        return etag
+
+    async def get_object(self, bucket: str, key: str) -> tuple[bytes, dict]:
+        meta = await self.head_object(bucket, key)
+        oid = _data_oid(bucket, key)
+        if meta["multipart"]:
+            data = await self._read_multipart(bucket, key)
+        elif meta["size"] > STRIPE_THRESHOLD:
+            data = await self.striper.read(oid)
+        else:
+            data = await self.client.read(self.pool_id, oid)
+        return data, meta
+
+    async def head_object(self, bucket: str, key: str) -> dict:
+        await self._require_bucket(bucket)
+        idx = await self.client.omap_get(self.pool_id,
+                                         _index_oid(bucket))
+        raw = idx.get(key.encode())
+        if raw is None:
+            raise RGWError("NoSuchKey", 404)
+        return _dec_entry(raw)
+
+    async def delete_object(self, bucket: str, key: str) -> None:
+        meta = await self.head_object(bucket, key)
+        oid = _data_oid(bucket, key)
+        if meta["multipart"]:
+            await self._delete_multipart(bucket, key)
+        elif meta["size"] > STRIPE_THRESHOLD:
+            await self.striper.remove(oid)
+        else:
+            try:
+                await self.client.delete(self.pool_id, oid)
+            except KeyError:
+                pass
+        await self.client.omap_rm(self.pool_id, _index_oid(bucket),
+                                  [key.encode()])
+
+    async def copy_object(self, src_bucket: str, src_key: str,
+                          dst_bucket: str, dst_key: str) -> str:
+        data, _ = await self.get_object(src_bucket, src_key)
+        return await self.put_object(dst_bucket, dst_key, data)
+
+    async def list_objects(self, bucket: str, prefix: str = "",
+                           marker: str = "", max_keys: int = 1000):
+        """(entries, truncated) in lexicographic key order — straight
+        off the bucket-index omap (ListObjectsV2 role)."""
+        await self._require_bucket(bucket)
+        idx = await self.client.omap_get(self.pool_id,
+                                         _index_oid(bucket))
+        keys = sorted(k.decode() for k in idx)
+        out = []
+        for k in keys:
+            if prefix and not k.startswith(prefix):
+                continue
+            if marker and k <= marker:
+                continue
+            if len(out) >= max_keys:
+                return out, True
+            e = _dec_entry(idx[k.encode()])
+            out.append({"key": k, **e})
+        return out, False
+
+    # ---------------------------------------------------------- multipart
+
+    def _part_oid(self, bucket: str, key: str, upload_id: str,
+                  part: int) -> str:
+        return f"{bucket}//{key}.__part.{upload_id}.{part:05d}"
+
+    async def initiate_multipart(self, bucket: str, key: str) -> str:
+        await self._require_bucket(bucket)
+        upload_id = hashlib.md5(
+            f"{bucket}/{key}/{time.time()}".encode()
+        ).hexdigest()[:16]
+        return upload_id
+
+    async def upload_part(self, bucket: str, key: str, upload_id: str,
+                          part: int, data: bytes) -> str:
+        if not 1 <= part <= 10000:
+            raise RGWError("InvalidPartNumber")
+        oid = self._part_oid(bucket, key, upload_id, part)
+        await self.client.write_full(self.pool_id, oid, data)
+        return hashlib.md5(data).hexdigest()
+
+    async def complete_multipart(self, bucket: str, key: str,
+                                 upload_id: str,
+                                 parts: list[int]) -> str:
+        """Write the manifest; data stays in the part objects (the RGW
+        manifest stance — no copy at complete time)."""
+        total = 0
+        md5s = b""
+        manifest = []
+        for p in parts:
+            oid = self._part_oid(bucket, key, upload_id, p)
+            try:
+                size = await self.client.stat(self.pool_id, oid)
+            except KeyError:
+                raise RGWError("InvalidPart") from None
+            data = await self.client.read(self.pool_id, oid)
+            md5s += hashlib.md5(data).digest()
+            total += size
+            manifest.append((oid, size))
+        etag = f"{hashlib.md5(md5s).hexdigest()}-{len(parts)}"
+        enc = denc.enc_list(
+            manifest,
+            lambda e: denc.enc_str(e[0]) + denc.enc_u64(e[1]),
+        )
+        await self.client.write_full(
+            self.pool_id, _data_oid(bucket, key) + ".__manifest", enc
+        )
+        await self.client.omap_set(
+            self.pool_id, _index_oid(bucket),
+            {key.encode(): _enc_entry(total, etag, time.time(),
+                                      multipart=True)},
+        )
+        return etag
+
+    async def _read_multipart(self, bucket: str, key: str) -> bytes:
+        raw = await self.client.read(
+            self.pool_id, _data_oid(bucket, key) + ".__manifest"
+        )
+
+        def one(b, o):
+            oid, o = denc.dec_str(b, o)
+            size, o = denc.dec_u64(b, o)
+            return (oid, size), o
+
+        manifest, _ = denc.dec_list(raw, 0, one)
+        chunks = await asyncio.gather(*(
+            self.client.read(self.pool_id, oid) for oid, _ in manifest
+        ))
+        return b"".join(chunks)
+
+    async def _delete_multipart(self, bucket: str, key: str) -> None:
+        raw = await self.client.read(
+            self.pool_id, _data_oid(bucket, key) + ".__manifest"
+        )
+
+        def one(b, o):
+            oid, o = denc.dec_str(b, o)
+            size, o = denc.dec_u64(b, o)
+            return (oid, size), o
+
+        manifest, _ = denc.dec_list(raw, 0, one)
+        for oid, _size in manifest:
+            try:
+                await self.client.delete(self.pool_id, oid)
+            except KeyError:
+                pass
+        await self.client.delete(
+            self.pool_id, _data_oid(bucket, key) + ".__manifest"
+        )
+
+
+# ================================================== HTTP frontend
+
+
+def _xml(root: ET.Element) -> bytes:
+    return (b'<?xml version="1.0" encoding="UTF-8"?>'
+            + ET.tostring(root))
+
+
+class S3Frontend:
+    """Minimal S3 REST dialect over asyncio TCP (rgw_asio_frontend
+    role): virtual-path addressing, XML bodies, no auth (the reference
+    gates with sigv4; DummyAuth tier here)."""
+
+    def __init__(self, rgw: RGWLite):
+        self.rgw = rgw
+        self._server: asyncio.AbstractServer | None = None
+        self.port = 0
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0):
+        self._server = await asyncio.start_server(self._conn, host, port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        return host, self.port
+
+    async def stop(self) -> None:
+        if self._server:
+            self._server.close()
+            await self._server.wait_closed()
+
+    async def _conn(self, reader: asyncio.StreamReader,
+                    writer: asyncio.StreamWriter) -> None:
+        try:
+            while True:
+                line = await reader.readline()
+                if not line:
+                    return
+                method, target, _ = line.decode().split(" ", 2)
+                headers = {}
+                while True:
+                    h = await reader.readline()
+                    if h in (b"\r\n", b"\n", b""):
+                        break
+                    k, v = h.decode().split(":", 1)
+                    headers[k.strip().lower()] = v.strip()
+                body = b""
+                n = int(headers.get("content-length", "0"))
+                if n:
+                    body = await reader.readexactly(n)
+                status, rheaders, rbody = await self._route(
+                    method, target, headers, body
+                )
+                reason = {200: "OK", 204: "No Content", 404: "Not Found",
+                          400: "Bad Request", 409: "Conflict"}.get(
+                    status, "Error")
+                head = [f"HTTP/1.1 {status} {reason}"]
+                rheaders.setdefault("content-length", str(len(rbody)))
+                rheaders.setdefault("connection", "keep-alive")
+                for k, v in rheaders.items():
+                    head.append(f"{k}: {v}")
+                writer.write(("\r\n".join(head) + "\r\n\r\n").encode()
+                             + rbody)
+                await writer.drain()
+        except (asyncio.IncompleteReadError, ConnectionError,
+                ValueError):
+            pass
+        finally:
+            writer.close()
+
+    async def _route(self, method: str, target: str, headers: dict,
+                     body: bytes):
+        parsed = urllib.parse.urlsplit(target)
+        path = urllib.parse.unquote(parsed.path)
+        query = urllib.parse.parse_qs(parsed.query)
+        parts = [p for p in path.split("/") if p]
+        try:
+            if not parts:
+                if method == "GET":
+                    return await self._list_buckets()
+                return 400, {}, b""
+            bucket = parts[0]
+            key = "/".join(parts[1:])
+            if not key:
+                if method == "PUT":
+                    await self.rgw.create_bucket(bucket)
+                    return 200, {}, b""
+                if method == "DELETE":
+                    await self.rgw.delete_bucket(bucket)
+                    return 204, {}, b""
+                if method == "GET":
+                    return await self._list_objects(bucket, query)
+                return 400, {}, b""
+            if method == "PUT":
+                src = headers.get("x-amz-copy-source")
+                if src:
+                    sb, _, sk = src.strip("/").partition("/")
+                    etag = await self.rgw.copy_object(sb, sk, bucket,
+                                                      key)
+                else:
+                    etag = await self.rgw.put_object(bucket, key, body)
+                return 200, {"etag": f'"{etag}"'}, b""
+            if method == "GET":
+                data, meta = await self.rgw.get_object(bucket, key)
+                return 200, {"etag": f'"{meta["etag"]}"'}, data
+            if method == "HEAD":
+                meta = await self.rgw.head_object(bucket, key)
+                return 200, {
+                    "etag": f'"{meta["etag"]}"',
+                    "content-length": str(meta["size"]),
+                }, b""
+            if method == "DELETE":
+                await self.rgw.delete_object(bucket, key)
+                return 204, {}, b""
+            return 400, {}, b""
+        except RGWError as e:
+            err = ET.Element("Error")
+            ET.SubElement(err, "Code").text = e.code
+            return e.status, {"content-type": "application/xml"}, \
+                _xml(err)
+
+    async def _list_buckets(self):
+        root = ET.Element("ListAllMyBucketsResult")
+        buckets = ET.SubElement(root, "Buckets")
+        for b in await self.rgw.list_buckets():
+            el = ET.SubElement(buckets, "Bucket")
+            ET.SubElement(el, "Name").text = b
+        return 200, {"content-type": "application/xml"}, _xml(root)
+
+    async def _list_objects(self, bucket: str, query: dict):
+        entries, truncated = await self.rgw.list_objects(
+            bucket,
+            prefix=query.get("prefix", [""])[0],
+            marker=query.get("marker", [""])[0]
+            or query.get("start-after", [""])[0],
+            max_keys=int(query.get("max-keys", ["1000"])[0]),
+        )
+        root = ET.Element("ListBucketResult")
+        ET.SubElement(root, "Name").text = bucket
+        ET.SubElement(root, "IsTruncated").text = \
+            "true" if truncated else "false"
+        for e in entries:
+            el = ET.SubElement(root, "Contents")
+            ET.SubElement(el, "Key").text = e["key"]
+            ET.SubElement(el, "Size").text = str(e["size"])
+            ET.SubElement(el, "ETag").text = f'"{e["etag"]}"'
+        return 200, {"content-type": "application/xml"}, _xml(root)

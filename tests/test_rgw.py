@@ -1,0 +1,166 @@
+"""RGW-lite tests: bucket/object API, listings, multipart, and the
+HTTP frontend driven over a real socket (the s3-tests role, shrunk)."""
+import asyncio
+import hashlib
+
+import numpy as np
+import pytest
+
+from ceph_tpu.cluster.vstart import TestCluster
+from ceph_tpu.placement.osdmap import Pool
+from ceph_tpu.services.rgw import RGWError, RGWLite, S3Frontend
+
+
+def run(coro):
+    asyncio.run(asyncio.wait_for(coro, 120))
+
+
+async def make():
+    c = TestCluster(n_osds=4)
+    await c.start()
+    await c.client.create_pool(
+        Pool(id=1, name="rgw", size=3, pg_num=8, crush_rule=0)
+    )
+    await c.wait_active(20)
+    return c, RGWLite(c.client, 1)
+
+
+def test_bucket_lifecycle():
+    async def t():
+        c, rgw = await make()
+        await rgw.create_bucket("alpha")
+        await rgw.create_bucket("beta")
+        with pytest.raises(RGWError, match="BucketAlreadyExists"):
+            await rgw.create_bucket("alpha")
+        with pytest.raises(RGWError, match="InvalidBucketName"):
+            await rgw.create_bucket("bad/name")
+        assert await rgw.list_buckets() == ["alpha", "beta"]
+        await rgw.put_object("alpha", "k", b"v")
+        with pytest.raises(RGWError, match="BucketNotEmpty"):
+            await rgw.delete_bucket("alpha")
+        await rgw.delete_object("alpha", "k")
+        await rgw.delete_bucket("alpha")
+        assert await rgw.list_buckets() == ["beta"]
+        with pytest.raises(RGWError, match="NoSuchBucket"):
+            await rgw.put_object("gone", "k", b"v")
+        await c.stop()
+
+    run(t())
+
+
+def test_object_roundtrip_and_listing():
+    async def t():
+        c, rgw = await make()
+        await rgw.create_bucket("b")
+        data = b"hello s3 world"
+        etag = await rgw.put_object("b", "docs/readme.txt", data)
+        assert etag == hashlib.md5(data).hexdigest()
+        got, meta = await rgw.get_object("b", "docs/readme.txt")
+        assert got == data and meta["etag"] == etag
+        for k in ("docs/a", "docs/b", "logs/1", "logs/2", "zzz"):
+            await rgw.put_object("b", k, k.encode())
+        entries, trunc = await rgw.list_objects("b")
+        keys = [e["key"] for e in entries]
+        assert keys == sorted(keys) and not trunc
+        docs, _ = await rgw.list_objects("b", prefix="docs/")
+        assert [e["key"] for e in docs] == ["docs/a", "docs/b",
+                                           "docs/readme.txt"]
+        page1, trunc = await rgw.list_objects("b", max_keys=2)
+        assert len(page1) == 2 and trunc
+        page2, _ = await rgw.list_objects("b", marker=page1[-1]["key"])
+        assert page2[0]["key"] > page1[-1]["key"]
+        # overwrite changes etag; copy preserves content
+        await rgw.put_object("b", "zzz", b"new")
+        await rgw.copy_object("b", "zzz", "b", "zzz-copy")
+        got2, _ = await rgw.get_object("b", "zzz-copy")
+        assert got2 == b"new"
+        await rgw.delete_object("b", "zzz")
+        with pytest.raises(RGWError, match="NoSuchKey"):
+            await rgw.get_object("b", "zzz")
+        await c.stop()
+
+    run(t())
+
+
+def test_multipart_upload():
+    async def t():
+        c, rgw = await make()
+        await rgw.create_bucket("mp")
+        upload = await rgw.initiate_multipart("mp", "big")
+        rng = np.random.default_rng(5)
+        parts = [rng.integers(0, 256, 50_000, dtype=np.uint8).tobytes()
+                 for _ in range(3)]
+        for i, p in enumerate(parts, start=1):
+            await rgw.upload_part("mp", "big", upload, i, p)
+        etag = await rgw.complete_multipart("mp", "big", upload,
+                                            [1, 2, 3])
+        assert etag.endswith("-3")
+        got, meta = await rgw.get_object("mp", "big")
+        assert got == b"".join(parts)
+        assert meta["size"] == 150_000 and meta["multipart"]
+        await rgw.delete_object("mp", "big")
+        entries, _ = await rgw.list_objects("mp")
+        assert entries == []
+        await c.stop()
+
+    run(t())
+
+
+async def http(host, port, method, path, body=b"", headers=None):
+    reader, writer = await asyncio.open_connection(host, port)
+    head = [f"{method} {path} HTTP/1.1", f"host: {host}",
+            f"content-length: {len(body)}"]
+    for k, v in (headers or {}).items():
+        head.append(f"{k}: {v}")
+    writer.write(("\r\n".join(head) + "\r\n\r\n").encode() + body)
+    await writer.drain()
+    status_line = await reader.readline()
+    status = int(status_line.split()[1])
+    rheaders = {}
+    while True:
+        h = await reader.readline()
+        if h in (b"\r\n", b"\n"):
+            break
+        k, v = h.decode().split(":", 1)
+        rheaders[k.strip().lower()] = v.strip()
+    n = int(rheaders.get("content-length", "0"))
+    # HEAD advertises the entity length but carries no body
+    rbody = await reader.readexactly(n) if n and method != "HEAD" else b""
+    writer.close()
+    return status, rheaders, rbody
+
+
+def test_http_frontend():
+    async def t():
+        c, rgw = await make()
+        fe = S3Frontend(rgw)
+        host, port = await fe.start()
+        assert (await http(host, port, "PUT", "/photos"))[0] == 200
+        st, hd, _ = await http(host, port, "PUT", "/photos/cat.jpg",
+                               b"MEOW" * 100)
+        assert st == 200 and hd["etag"].strip('"') == hashlib.md5(
+            b"MEOW" * 100
+        ).hexdigest()
+        st, hd, body = await http(host, port, "GET", "/photos/cat.jpg")
+        assert st == 200 and body == b"MEOW" * 100
+        st, hd, _ = await http(host, port, "HEAD", "/photos/cat.jpg")
+        assert st == 200 and hd["content-length"] == "400"
+        # copy via x-amz-copy-source
+        st, _, _ = await http(host, port, "PUT", "/photos/cat2.jpg",
+                              headers={"x-amz-copy-source":
+                                       "/photos/cat.jpg"})
+        assert st == 200
+        st, _, body = await http(host, port, "GET",
+                                 "/photos?prefix=cat")
+        assert st == 200 and b"<Key>cat.jpg</Key>" in body \
+            and b"<Key>cat2.jpg</Key>" in body
+        st, _, body = await http(host, port, "GET", "/")
+        assert b"<Name>photos</Name>" in body
+        assert (await http(host, port, "DELETE",
+                           "/photos/cat.jpg"))[0] == 204
+        st, _, body = await http(host, port, "GET", "/photos/cat.jpg")
+        assert st == 404 and b"NoSuchKey" in body
+        await fe.stop()
+        await c.stop()
+
+    run(t())
