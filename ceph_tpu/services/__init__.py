@@ -7,3 +7,5 @@ the reference's 400 K LoC service stack.
 from __future__ import annotations
 
 from .rbd import RBD, Image, ImageNotFound  # noqa: F401
+from .fs import FSLite  # noqa: F401
+from .rgw import RGWLite, S3Frontend  # noqa: F401
