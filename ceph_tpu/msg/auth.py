@@ -1,0 +1,114 @@
+"""Auth: shared-secret authentication + frame signing (the src/auth
+cephx role, compressed to its load-bearing arc).
+
+KeyServer (CephxKeyServer role) holds per-entity secrets. A connecting
+messenger proves identity with a challenge/response handshake —
+acceptor issues a random challenge, connector answers
+HMAC(secret, challenge || nonce || entity) — and the session derives a
+signing key from both nonces, after which every frame carries an HMAC
+tag (the msgr2 "signed" mode, frames_v2 auth role; AES-GCM "secure"
+mode is out of scope). Replay of a recorded handshake fails because
+the acceptor's challenge is fresh per connection.
+"""
+from __future__ import annotations
+
+import hashlib
+import hmac
+import os
+
+
+class AuthError(Exception):
+    pass
+
+
+class KeyServer:
+    """Entity -> secret registry (CephxKeyServer role)."""
+
+    def __init__(self) -> None:
+        self._keys: dict[str, bytes] = {}
+
+    def add(self, entity: str, secret: bytes | None = None) -> bytes:
+        if secret is None:
+            secret = os.urandom(32)
+        self._keys[entity] = bytes(secret)
+        return self._keys[entity]
+
+    def get(self, entity: str) -> bytes | None:
+        return self._keys.get(entity)
+
+
+def _mac(key: bytes, *parts: bytes) -> bytes:
+    h = hmac.new(key, digestmod=hashlib.sha256)
+    for p in parts:
+        h.update(len(p).to_bytes(4, "little"))
+        h.update(p)
+    return h.digest()
+
+
+class Authenticator:
+    """Session auth state for one connection side."""
+
+    def __init__(self, entity: str, secret: bytes):
+        self.entity = entity
+        self.secret = secret
+        self.session_key: bytes | None = None
+
+    # ------------------------------------------------------ handshake
+
+    def make_hello(self) -> tuple[bytes, bytes]:
+        """Connector step 1: (hello_payload, nonce)."""
+        nonce = os.urandom(16)
+        return self.entity.encode() + b"\0" + nonce, nonce
+
+    @staticmethod
+    def parse_hello(payload: bytes) -> tuple[str, bytes]:
+        entity, _, nonce = payload.partition(b"\0")
+        if not nonce:
+            raise AuthError("malformed hello")
+        return entity.decode(), nonce
+
+    @staticmethod
+    def make_challenge() -> bytes:
+        return os.urandom(16)
+
+    def prove(self, challenge: bytes, nonce: bytes) -> bytes:
+        """Connector step 2: the proof the acceptor verifies."""
+        return _mac(self.secret, challenge, nonce, self.entity.encode())
+
+    def verify_proof(self, proof: bytes, challenge: bytes,
+                     nonce: bytes, entity: str,
+                     their_secret: bytes) -> None:
+        want = _mac(their_secret, challenge, nonce, entity.encode())
+        if not hmac.compare_digest(proof, want):
+            raise AuthError(f"bad proof from {entity!r}")
+
+    def derive_session(self, secret: bytes, challenge: bytes,
+                       nonce: bytes) -> None:
+        """Both sides derive the same signing key (session ticket
+        role)."""
+        self.session_key = _mac(secret, b"session", challenge, nonce)
+
+    # -------------------------------------------------- frame signing
+
+    def sign(self, frame_bytes: bytes) -> bytes:
+        if self.session_key is None:
+            raise AuthError("no session key")
+        return _mac(self.session_key, frame_bytes)[:16]
+
+    def check(self, frame_bytes: bytes, tag: bytes) -> None:
+        if not hmac.compare_digest(self.sign(frame_bytes), tag):
+            raise AuthError("frame signature mismatch")
+
+
+def handshake_accept(keys: KeyServer, hello: bytes,
+                     challenge: bytes, proof: bytes) -> bytes:
+    """Acceptor-side verification: returns the session key or raises
+    (the cephx do-you-know-the-secret arc)."""
+    entity, nonce = Authenticator.parse_hello(hello)
+    secret = keys.get(entity)
+    if secret is None:
+        raise AuthError(f"unknown entity {entity!r}")
+    want = _mac(secret, challenge, nonce, entity.encode())
+    if not hmac.compare_digest(proof, want):
+        raise AuthError(f"bad proof from {entity!r}")
+    return _mac(secret, b"session", challenge, nonce)

@@ -18,6 +18,7 @@ import asyncio
 from typing import Awaitable, Callable
 
 from ..utils import denc
+from .auth import AuthError
 from .frames import Frame, FrameError, IncompleteFrame, decode_frame, encode_frame
 from .messages import Message, decode_message
 
@@ -82,13 +83,24 @@ class TcpMessenger:
     Peers are located through an address book {entity: (host, port)} —
     the role the reference's maps' addrvecs play. Outgoing connections
     are cached and re-dialed on failure.
+
+    With ``keys`` set (a KeyServer holding this entity's secret and the
+    peers'), connections run the cephx-role handshake (msg/auth.py) and
+    every subsequent frame carries an HMAC tag (msgr2 signed mode);
+    unauthenticated peers and tampered frames are rejected.
     """
 
-    def __init__(self, name: str, dispatcher: Dispatcher):
+    AUTH_HELLO = 0xFF01
+    AUTH_CHALLENGE = 0xFF02
+    AUTH_PROOF = 0xFF03
+    AUTH_OK = 0xFF04
+
+    def __init__(self, name: str, dispatcher: Dispatcher, keys=None):
         self.name = name
         self.dispatcher = dispatcher
+        self.keys = keys  # KeyServer | None
         self.addrbook: dict[str, tuple[str, int]] = {}
-        self._conns: dict[str, asyncio.StreamWriter] = {}
+        self._conns: dict[str, tuple] = {}  # dst -> (writer, auth|None)
         self._server: asyncio.AbstractServer | None = None
         self._readers: set[asyncio.Task] = set()
 
@@ -103,7 +115,7 @@ class TcpMessenger:
         # drained FIRST or close deadlocks on any open connection
         if self._server:
             self._server.close()
-        for w in self._conns.values():
+        for w, _auth in self._conns.values():
             w.close()
         self._conns.clear()
         readers = list(self._readers)
@@ -118,45 +130,131 @@ class TcpMessenger:
         task = asyncio.current_task()
         self._readers.add(task)
         try:
-            await self._read_loop(reader)
-        except (asyncio.IncompleteReadError, ConnectionError):
+            auth = None
+            if self.keys is not None:
+                auth = await self._accept_handshake(reader, writer)
+            await self._read_loop(reader, auth)
+        except (asyncio.IncompleteReadError, ConnectionError,
+                AuthError):
             pass
         finally:
             self._readers.discard(task)
             writer.close()
 
-    async def _read_loop(self, reader: asyncio.StreamReader) -> None:
+    async def _accept_handshake(self, reader, writer):
+        """Acceptor side of the cephx-role handshake."""
+        from .auth import Authenticator, handshake_accept
+
+        hello = await self._read_one_frame(reader)
+        if hello is None or hello.type != self.AUTH_HELLO:
+            raise AuthError("expected AUTH_HELLO")
+        challenge = Authenticator.make_challenge()
+        writer.write(encode_frame(
+            Frame(self.AUTH_CHALLENGE, challenge)
+        ))
+        await writer.drain()
+        proof = await self._read_one_frame(reader)
+        if proof is None or proof.type != self.AUTH_PROOF:
+            raise AuthError("expected AUTH_PROOF")
+        session = handshake_accept(self.keys, hello.payload, challenge,
+                                   proof.payload)
+        entity, _nonce = Authenticator.parse_hello(hello.payload)
+        auth = Authenticator(entity, b"")
+        auth.session_key = session
+        writer.write(encode_frame(Frame(self.AUTH_OK, b"")))
+        await writer.drain()
+        return auth
+
+    @staticmethod
+    async def _read_one_frame(reader) -> Frame | None:
+        buf = b""
+        while True:
+            try:
+                frame, used = decode_frame(buf)
+                return frame
+            except IncompleteFrame as need:
+                chunk = await reader.read(
+                    max(need.needed - len(buf), 4096)
+                )
+                if not chunk:
+                    return None
+                buf += chunk
+
+    async def _read_loop(self, reader: asyncio.StreamReader,
+                         auth=None) -> None:
         buf = b""
         while True:
             try:
                 frame, used = decode_frame(buf)
             except IncompleteFrame as need:
-                chunk = await reader.read(max(need.needed - len(buf), 4096))
+                want = need.needed + (16 if auth else 0)
+                chunk = await reader.read(max(want - len(buf), 4096))
                 if not chunk:
                     return
                 buf += chunk
                 continue
             except FrameError:
                 raise ConnectionError("corrupt frame")
+            if auth is not None:
+                # signed mode: 16-byte HMAC trails every frame
+                while len(buf) < used + 16:
+                    chunk = await reader.read(4096)
+                    if not chunk:
+                        return
+                    buf += chunk
+                auth.check(bytes(buf[:used]), bytes(buf[used:used + 16]))
+                used += 16
             buf = buf[used:]
             sender, off = denc.dec_str(frame.payload, 0)
             msg = decode_message(frame.type, frame.payload[off:])
             await self.dispatcher(sender, msg)
 
+    async def _connect(self, dst: str):
+        if dst not in self.addrbook:
+            raise SendError(f"no address for {dst!r}")
+        host, port = self.addrbook[dst]
+        try:
+            reader, writer = await asyncio.open_connection(host, port)
+        except OSError as e:
+            raise SendError(f"connect to {dst} failed: {e}") from e
+        auth = None
+        if self.keys is not None:
+            from .auth import Authenticator
+
+            secret = self.keys.get(self.name)
+            if secret is None:
+                raise SendError(f"no secret for {self.name!r}")
+            auth = Authenticator(self.name, secret)
+            hello, nonce = auth.make_hello()
+            writer.write(encode_frame(Frame(self.AUTH_HELLO, hello)))
+            await writer.drain()
+            challenge = await self._read_one_frame(reader)
+            if challenge is None or challenge.type != self.AUTH_CHALLENGE:
+                writer.close()
+                raise SendError("auth: no challenge")
+            writer.write(encode_frame(
+                Frame(self.AUTH_PROOF,
+                      auth.prove(challenge.payload, nonce))
+            ))
+            await writer.drain()
+            ok = await self._read_one_frame(reader)
+            if ok is None or ok.type != self.AUTH_OK:
+                writer.close()
+                raise SendError("auth rejected")
+            auth.derive_session(secret, challenge.payload, nonce)
+        return writer, auth
+
     async def send(self, dst: str, msg: Message) -> None:
+        conn = self._conns.get(dst)
+        if conn is None or conn[0].is_closing():
+            conn = await self._connect(dst)
+            self._conns[dst] = conn
+        writer, auth = conn
         wire = encode_frame(
             Frame(msg.TYPE, denc.enc_str(self.name) + msg.encode())
         )
-        writer = self._conns.get(dst)
-        if writer is None or writer.is_closing():
-            if dst not in self.addrbook:
-                raise SendError(f"no address for {dst!r}")
-            host, port = self.addrbook[dst]
-            try:
-                _, writer = await asyncio.open_connection(host, port)
-            except OSError as e:
-                raise SendError(f"connect to {dst} failed: {e}") from e
-            self._conns[dst] = writer
+        if auth is not None:
+            wire += auth.sign(wire)
         try:
             writer.write(wire)
             await writer.drain()
