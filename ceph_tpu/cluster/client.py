@@ -36,6 +36,8 @@ class RadosClient:
         self._ops: dict[int, _InFlight] = {}
         self._pools: dict[str, int] = {}
         self._map_waiters: list[asyncio.Future] = []
+        self._watches: dict[tuple[bytes, int], object] = {}
+        self._next_cookie = 0
 
     # ---------------------------------------------------------- lifecycle
 
@@ -58,6 +60,10 @@ class RadosClient:
     async def handle(self, src: str, msg) -> None:
         if isinstance(msg, M.MOSDMapMsg):
             self._apply_map(msg)
+        elif isinstance(msg, M.MNotifyEvent):
+            cb = self._watches.get((msg.oid, msg.cookie))
+            if cb is not None:
+                cb(msg.oid, msg.notify_id, msg.payload)
         elif isinstance(msg, M.MOSDOpReply):
             await self._handle_reply(msg)
         elif isinstance(msg, M.MPoolCreateReply):
@@ -286,6 +292,41 @@ class RadosClient:
             pool_id, name,
             [M.osd_op("omap_rmkeys", keys=[bytes(k) for k in keys])],
         )
+
+    async def watch(self, pool_id: int, name, callback) -> int:
+        """Register interest in an object (librados watch role):
+        callback(oid, notify_id, payload) fires on every notify.
+        Watch state lives with the primary; re-watch after a primary
+        failover (the reference's client re-registers on timeout)."""
+        self._next_cookie += 1
+        cookie = self._next_cookie
+        oid = name.encode() if isinstance(name, str) else bytes(name)
+        await self._submit(
+            pool_id, name,
+            [M.osd_op("watch", offset=cookie, length=1)],
+        )
+        self._watches[(oid, cookie)] = callback
+        return cookie
+
+    async def unwatch(self, pool_id: int, name, cookie: int) -> None:
+        oid = name.encode() if isinstance(name, str) else bytes(name)
+        self._watches.pop((oid, cookie), None)
+        await self._submit(
+            pool_id, name,
+            [M.osd_op("watch", offset=cookie, length=0)],
+        )
+
+    async def notify(self, pool_id: int, name,
+                     payload: bytes = b"") -> int:
+        """Fan a notification out to every watcher; returns the notify
+        id (librados notify role, fire-and-forget acks)."""
+        reply = await self._submit(
+            pool_id, name,
+            [M.osd_op("notify", data=bytes(payload))],
+        )
+        from ..utils import denc
+
+        return denc.dec_u64(reply.outs[0][1], 0)[0]
 
     async def execute(self, pool_id: int, name, cls: str, method: str,
                       inp: bytes = b"") -> bytes:

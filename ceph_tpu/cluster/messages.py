@@ -304,6 +304,14 @@ class MPGScanReply(Message):
               ("objects", "map:bytes:" + EVERSION))
 
 
+@register_message
+class MNotifyEvent(Message):
+    TYPE = 56
+    # delivered to each watcher of oid (MWatchNotify role)
+    FIELDS = (("oid", "bytes"), ("notify_id", "u64"), ("cookie", "u64"),
+              ("payload", "bytes"))
+
+
 # ------------------------------------------------------------ mon <-> mon
 
 

@@ -118,6 +118,11 @@ class PG:
         self.log = PGLog()
         self.acting: list[int] = []
         self.primary: int = -1
+        #: oid -> {(entity, cookie)} — watch state lives with the
+        #: primary (the reference persists it on the object + session;
+        #: lite keeps it in-memory, so clients re-watch after failover)
+        self.watchers: dict[bytes, set[tuple[str, int]]] = {}
+        self._notify_id = 0
         self.state = "peering"
         self.waiting: list[tuple[str, M.MOSDOp]] = []
         self.lock = asyncio.Lock()
@@ -236,9 +241,11 @@ class PG:
         try:
             if write_class:
                 async with self.lock:
-                    outs, size = await self._execute_ops(m.oid, m.ops)
+                    outs, size = await self._execute_ops(m.oid, m.ops,
+                                                         src=src)
             else:
-                outs, size = await self._execute_ops(m.oid, m.ops)
+                outs, size = await self._execute_ops(m.oid, m.ops,
+                                                     src=src)
             first = next((d for r, d in outs if d), b"")
             reply = M.MOSDOpReply(tid=m.tid, result=M.OK, data=first,
                                   size=size, outs=outs,
@@ -261,7 +268,8 @@ class PG:
 
     # ------------------------------------------------- op-vector engine
 
-    async def _execute_ops(self, oid: bytes, ops) -> tuple[list, int]:
+    async def _execute_ops(self, oid: bytes, ops,
+                           src: str = "") -> tuple[list, int]:
         """Apply the op vector against a working copy of the object
         (do_osd_ops role): reads inside the vector see earlier writes,
         mutations commit atomically at the end, any failure aborts the
@@ -359,6 +367,26 @@ class PG:
                 self._check_omap()
                 state["omap"].clear()
                 state["omap_header"] = b""
+            elif op == "watch":
+                # register/unregister src as a watcher (librados watch
+                # role; offset carries the cookie, length 0 = unwatch)
+                self._check_exists(exists0, mutated)
+                ws = self.watchers.setdefault(oid, set())
+                if length == 0:
+                    ws.discard((src, offset))
+                else:
+                    ws.add((src, offset))
+            elif op == "notify":
+                self._check_exists(exists0, mutated)
+                self._notify_id += 1
+                nid = self._notify_id
+                for entity, cookie in self.watchers.get(oid, set()):
+                    self.osd.spawn(self.osd.send(
+                        entity,
+                        M.MNotifyEvent(oid=oid, notify_id=nid,
+                                       cookie=cookie, payload=payload),
+                    ))
+                out = denc.enc_u64(nid)
             elif op == "call":
                 # server-side object class method (objclass exec role)
                 from . import cls as cls_mod
