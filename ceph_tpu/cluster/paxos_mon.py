@@ -287,6 +287,22 @@ class PaxosMon(MonLite):
         elif isinstance(msg, M.MMonGetMap):
             self.subscribers.add(src)
             await super().handle(src, msg)
+        elif isinstance(msg, (M.MOSDBoot, M.MFailure, M.MPoolCreate)):
+            # map-mutating requests: a peon forwards to the leader
+            # (Monitor::forward_request_leader role); commits that race
+            # a leadership change fail quietly and the requester retries
+            if not self.is_leader():
+                if self.leader is not None:
+                    try:
+                        await self.bus.send(src, f"mon.{self.leader}",
+                                            msg)
+                    except Exception:
+                        pass
+                return
+            try:
+                await super().handle(src, msg)
+            except QuorumLost:
+                pass
         else:
             await super().handle(src, msg)
 
