@@ -124,6 +124,33 @@ class ObjectStore:
                 raise StoreError(f"collection {op.cid} not empty")
             del colls[op.cid]
             return
+        if op.code == tx.OP_SPLIT_COLL:
+            src = colls.get(op.cid)
+            if src is None:
+                raise NotFound(op.cid)
+            dest = colls.get(op.args["dest_cid"])
+            if dest is None:
+                raise NotFound(op.args["dest_cid"])
+            mask = (1 << op.args["bits"]) - 1
+            from ..placement.osdmap import ceph_str_hash_rjenkins
+
+            moving = [
+                oid for oid in src.objects
+                if ceph_str_hash_rjenkins(oid) & mask == op.args["rem"]
+            ]
+            for oid in moving:
+                dest.objects[oid] = src.objects.pop(oid)
+            return
+        if op.code == tx.OP_MERGE_COLL:
+            src = colls.get(op.cid)
+            if src is None:
+                raise NotFound(op.cid)
+            dest = colls.get(op.args["dest_cid"])
+            if dest is None:
+                raise NotFound(op.args["dest_cid"])
+            dest.objects.update(src.objects)
+            del colls[op.cid]
+            return
         c = colls.get(op.cid)
         if c is None:
             raise NotFound(f"collection {op.cid}")
@@ -159,6 +186,7 @@ class ObjectStore:
             if op.code in (
                 tx.OP_WRITE, tx.OP_ZERO, tx.OP_TRUNCATE, tx.OP_SETATTR,
                 tx.OP_SETATTRS, tx.OP_OMAP_SETKEYS, tx.OP_OMAP_SETHEADER,
+                tx.OP_SETALLOCHINT,
             ):
                 o = c.objects.setdefault(op.oid, Obj())
             else:
@@ -199,5 +227,12 @@ class ObjectStore:
                 del o.omap[k]
         elif op.code == tx.OP_OMAP_SETHEADER:
             o.omap_header = a["header"]
+        elif op.code == tx.OP_SETALLOCHINT:
+            # advisory: recorded for allocator-aware stores
+            o.xattrs["_alloc_hint"] = (
+                a["expected_object_size"].to_bytes(8, "little")
+                + a["expected_write_size"].to_bytes(8, "little")
+                + a["flags"].to_bytes(4, "little")
+            )
         else:
             raise StoreError(f"unknown op {op.code}")

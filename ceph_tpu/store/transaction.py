@@ -25,6 +25,9 @@ OP_CLONE = "clone"
 OP_CLONERANGE = "clone_range"
 OP_MKCOLL = "mkcoll"
 OP_RMCOLL = "rmcoll"
+OP_SPLIT_COLL = "split_coll"
+OP_MERGE_COLL = "merge_coll"
+OP_SETALLOCHINT = "set_alloc_hint"
 OP_OMAP_CLEAR = "omap_clear"
 OP_OMAP_SETKEYS = "omap_setkeys"
 OP_OMAP_RMKEYS = "omap_rmkeys"
@@ -103,6 +106,27 @@ class Transaction:
 
     def remove_collection(self, cid: str):
         return self._add(OP_RMCOLL, cid)
+
+    def split_collection(self, cid: str, bits: int, rem: int, dest: str):
+        """PG split (Transaction::split_collection role): objects whose
+        hash matches `rem` under a `bits`-wide mask move to `dest`."""
+        return self._add(OP_SPLIT_COLL, cid, bits=bits, rem=rem,
+                         dest_cid=dest)
+
+    def merge_collection(self, cid: str, dest: str, bits: int = 0):
+        """PG merge: every object of `cid` moves into `dest`, then
+        `cid` is removed (Transaction::merge_collection role)."""
+        return self._add(OP_MERGE_COLL, cid, bits=bits, dest_cid=dest)
+
+    def set_alloc_hint(self, cid: str, oid: bytes,
+                       expected_object_size: int,
+                       expected_write_size: int, flags: int = 0):
+        """Advisory allocation hint (OP_SETALLOCHINT role): recorded on
+        the object for allocator-aware stores."""
+        return self._add(OP_SETALLOCHINT, cid, oid,
+                         expected_object_size=expected_object_size,
+                         expected_write_size=expected_write_size,
+                         flags=flags)
 
     # ------------------------------------------------------------ omap ops
 
@@ -203,6 +227,10 @@ def _arg_schema():
         OP_CLONERANGE: {"dest": b, "src_off": u, "length": u, "dst_off": u},
         OP_MKCOLL: {},
         OP_RMCOLL: {},
+        OP_SPLIT_COLL: {"bits": u, "rem": u, "dest_cid": s},
+        OP_MERGE_COLL: {"bits": u, "dest_cid": s},
+        OP_SETALLOCHINT: {"expected_object_size": u,
+                          "expected_write_size": u, "flags": u},
         OP_OMAP_CLEAR: {},
         OP_OMAP_SETKEYS: {"kv": kvmap},
         OP_OMAP_RMKEYS: {"keys": keylist},

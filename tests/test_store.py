@@ -131,6 +131,55 @@ def test_denc_roundtrips():
         denc.dec_u32(b"\x01\x02", 0)  # truncated
 
 
+def test_split_merge_collections():
+    """PG split/merge (Transaction split_collection/merge_collection
+    roles): objects partition by hash bits and reunite on merge."""
+    from ceph_tpu.placement.osdmap import ceph_str_hash_rjenkins
+
+    s = MemStore()
+    t = tx.Transaction().create_collection("1.0")
+    oids = [b"obj%d" % i for i in range(32)]
+    for oid in oids:
+        t.write("1.0", oid, 0, oid)
+    s.apply_transaction(t)
+    t2 = tx.Transaction().create_collection("1.1")
+    t2.split_collection("1.0", bits=1, rem=1, dest="1.1")
+    s.apply_transaction(t2)
+    left = set(s.list_objects("1.0"))
+    right = set(s.list_objects("1.1"))
+    assert left | right == set(oids) and not (left & right)
+    assert all(ceph_str_hash_rjenkins(o) & 1 == 0 for o in left)
+    assert all(ceph_str_hash_rjenkins(o) & 1 == 1 for o in right)
+    for oid in right:
+        assert s.read("1.1", oid) == oid  # data moved intact
+    # merge back reunites and removes the source
+    t3 = tx.Transaction().merge_collection("1.1", dest="1.0")
+    s.apply_transaction(t3)
+    assert set(s.list_objects("1.0")) == set(oids)
+    assert "1.1" not in s.list_collections()
+    # wire round-trip of the new opcodes
+    t4 = tx.Transaction()
+    t4.split_collection("1.0", 2, 3, "1.3")
+    t4.merge_collection("1.3", "1.0", bits=2)
+    t4.set_alloc_hint("1.0", b"obj0", 1 << 22, 4096, flags=3)
+    t5, used = tx.Transaction.decode(t4.encode())
+    assert used == len(t4.encode())
+    assert [op.code for op in t5.ops] == [
+        tx.OP_SPLIT_COLL, tx.OP_MERGE_COLL, tx.OP_SETALLOCHINT
+    ]
+
+
+def test_set_alloc_hint_recorded():
+    s = MemStore()
+    t = tx.Transaction().create_collection("c")
+    t.set_alloc_hint("c", b"new", 4 << 20, 64 << 10)
+    t.write("c", b"new", 0, b"data")
+    s.apply_transaction(t)
+    hint = s.getattr("c", b"new", "_alloc_hint")
+    assert int.from_bytes(hint[:8], "little") == 4 << 20
+    assert int.from_bytes(hint[8:16], "little") == 64 << 10
+
+
 # ------------------------------------------------------------- WalStore
 
 
