@@ -34,6 +34,11 @@ class MemStore(ObjectStore):
         by swapping the returned map in (under self.lock)."""
         with self.lock:
             touched = {op.cid for op in t.ops}
+            # split/merge mutate a destination collection too
+            touched |= {
+                op.args["dest_cid"] for op in t.ops
+                if "dest_cid" in op.args
+            }
             shadow = dict(self.colls)
             for cid in touched:
                 if cid in shadow:

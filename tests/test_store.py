@@ -169,6 +169,30 @@ def test_split_merge_collections():
     ]
 
 
+def test_split_merge_atomicity_with_preexisting_dest():
+    """A rejected transaction must not leak objects into a PRE-EXISTING
+    destination collection (the shadow must clone dest_cid too)."""
+    s = MemStore()
+    t = tx.Transaction()
+    t.create_collection("1.0")
+    t.create_collection("1.1")
+    for i in range(8):
+        t.write("1.1", b"o%d" % i, 0, b"x")
+    s.apply_transaction(t)
+    bad = tx.Transaction().merge_collection("1.1", dest="1.0")
+    bad.remove("1.0", b"nope")  # fails -> whole txn rolls back
+    with pytest.raises(NotFound):
+        s.queue_transaction(bad)
+    assert s.list_objects("1.0") == []  # nothing leaked into live dest
+    assert len(s.list_objects("1.1")) == 8
+    bad2 = tx.Transaction().split_collection("1.1", 1, 1, "1.0")
+    bad2.remove("1.0", b"nope")
+    with pytest.raises(NotFound):
+        s.queue_transaction(bad2)
+    assert s.list_objects("1.0") == []
+    assert len(s.list_objects("1.1")) == 8
+
+
 def test_set_alloc_hint_recorded():
     s = MemStore()
     t = tx.Transaction().create_collection("c")
