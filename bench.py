@@ -24,11 +24,13 @@ costs ~105 ms — both round-1 numbers were artifacts):
 - the fixed round-trip latency is measured separately with a trivial
   kernel and subtracted; iteration counts keep it a minor correction;
 - every timed iteration consumes a provably distinct input: a pre-staged
-  base XORed (inside the jitted kernel, fused — no extra HBM pass) with
-  a per-iteration salt;
-- timed kernels return only per-chunk CRCs (a few bytes) whose values
-  depend on every output word, so XLA cannot elide work and outputs
-  cannot accumulate in HBM;
+  base XORed with a per-iteration salt (the Pallas kernel is opaque to
+  XLA fusion, so the salted copy costs one extra HBM write+read of the
+  batch — the printed number under-reports the raw kernel, which is the
+  honest direction);
+- timed kernels return only per-stripe sums (a few bytes) that depend
+  on every output word, so XLA cannot elide work and outputs cannot
+  accumulate in HBM;
 - a roofline tripwire refuses to print a number whose implied HBM
   traffic exceeds the chip's spec bandwidth;
 - bit-exactness is checked untimed on a full batch: device parity vs the
@@ -66,7 +68,8 @@ CHUNK = 512 * 1024  # 4 MiB stripe / k
 BATCH = 24  # 96 MiB data per dispatch
 ERASED = (1, 6)  # two lost data shards
 PRESENT = tuple([i for i in range(K) if i not in ERASED] + [K, K + 1])
-ITERS = 24
+ITERS = 96  # per-iter cost is ~2 ms; a long chain amortizes the ~100 ms
+# tunnel round trip so its run-to-run jitter stays a minor correction
 THREADS = os.cpu_count() or 1
 
 # Roofline tripwire. The one real chip is a v5e ("TPU v5 lite"): ~819 GB/s
@@ -132,19 +135,20 @@ def headline(latency: float) -> dict:
 
     @jax.jit
     def enc_probe_2(b, salt):
-        # Salted input fuses into the matmul read; only CRCs (which cover
-        # every data+parity word) leave the device. b is an argument, not
-        # a closure constant (constants ship with the compile request).
-        _, crcs = datapath.write_step(params, b ^ salt)
-        return crcs
+        # Pure encode_chunks, the BASELINE config-2 shape (the reference
+        # harness ceph_erasure_code_benchmark times encode alone; hinfo
+        # CRCs are config 4's job). The salted input forces distinct work
+        # per iteration; the scalar sum depends on every parity word so
+        # nothing can be elided. b is an argument, not a closure constant
+        # (constants ship with the compile request).
+        parity = rs.gf_matmul(params.matrix, b ^ salt)
+        return jnp.sum(parity, axis=(1, 2))
 
     @jax.jit
     def dec_probe_2(b, salt):
         surv = (b ^ salt)[:, : len(PRESENT), :]  # shape (B, k, W)
-        decoded = rs.gf_matmul_u32(rmat, surv)
-        return crc_ops.crc32c_words_device(
-            decoded, crc_ops.zeros_shift(datapath.CRC_SEED, CHUNK)
-        )
+        decoded = rs.gf_matmul(rmat, surv)
+        return jnp.sum(decoded, axis=(1, 2))
 
     enc_probe = functools.partial(enc_probe_2, base)
     dec_probe = functools.partial(dec_probe_2, base)
@@ -156,8 +160,9 @@ def headline(latency: float) -> dict:
     dt = dt_enc + dt_dec
 
     data_bytes = BATCH * K * CHUNK
-    # Minimum HBM traffic per iteration: both passes read a data-sized
-    # input; outputs may fuse away into the CRC tree.
+    # Conservative lower bound on HBM traffic per iteration: both passes
+    # read a data-sized input (the salted copy and parity/decent writes
+    # add more, which only makes the tripwire stricter than it claims).
     traffic = 2 * data_bytes
     implied = traffic / dt
     if implied > HBM_BYTES_PER_S * ROOFLINE_SLACK:
@@ -205,10 +210,15 @@ def headline(latency: float) -> dict:
         ],
         axis=0,
     ).reshape(K, BATCH * CHUNK)
-    t0 = time.perf_counter()
-    native.rs_encode(params.matrix, flat, threads=THREADS)
-    native.rs_matmul(rmat, surv_flat, threads=THREADS)
-    dt_host = time.perf_counter() - t0
+    # median of 5: single-shot timing on a shared single-core VM swings
+    # 2x run to run; the median is the honest stable figure
+    host_times = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        native.rs_encode(params.matrix, flat, threads=THREADS)
+        native.rs_matmul(rmat, surv_flat, threads=THREADS)
+        host_times.append(time.perf_counter() - t0)
+    dt_host = sorted(host_times)[len(host_times) // 2]
     gibs_host = 2 * data_bytes / dt_host / 2**30
 
     return {

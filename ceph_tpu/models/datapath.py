@@ -63,7 +63,7 @@ def write_step(params: ECParams, data: jax.Array):
     crcs cover data chunks then parity chunks, the per-shard hash_info
     the EC backend persists next to each shard.
     """
-    parity = rs.gf_matmul_u32(params.matrix, data)
+    parity = rs.gf_matmul(params.matrix, data)
     chunks = jnp.concatenate([data, parity], axis=-2)
     return parity, _chunk_crcs(chunks, params.chunk_bytes)
 
@@ -74,7 +74,7 @@ def repair_step(params: ECParams, present: tuple[int, ...], surviving: jax.Array
     from the erasure pattern (tiny k x k inversion), the bulk math is the
     same device kernel as encode."""
     rmat = gf8.decode_matrix(params.matrix, params.k, list(present))
-    data = rs.gf_matmul_u32(rmat, surviving)
+    data = rs.gf_matmul(rmat, surviving)
     return data, _chunk_crcs(data, params.chunk_bytes)
 
 

@@ -26,6 +26,7 @@ the stripe batch — the axis the data path shards over the device mesh.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -88,19 +89,283 @@ def gf_matmul_u32(matrix: np.ndarray, chunks: jax.Array) -> jax.Array:
     return jnp.stack(outs, axis=-2)
 
 
+def _lift_bitmatrix(matrix: np.ndarray) -> np.ndarray:
+    """(R, C) GF(2^8) matrix -> (R*8, C*8) GF(2) bit-matrix.
+
+    Block (r, c) is the multiply-by-matrix[r,c] bit matrix: column j
+    holds the bits of matrix[r,c] * x^j (jerasure_matrix_to_bitmatrix
+    semantics) — so out_bit[r*8+i] = XOR over (c, j) of
+    block[i, j] * in_bit[c*8+j], exactly GF(2^8) algebra over GF(2).
+    """
+    rows, cols = matrix.shape
+    out = np.zeros((rows * 8, cols * 8), dtype=np.int8)
+    for r in range(rows):
+        for c in range(cols):
+            e = int(matrix[r, c])
+            v = e
+            for j in range(8):
+                for i in range(8):
+                    out[r * 8 + i, c * 8 + j] = (v >> i) & 1
+                v = gf8.gf_mul(v, 2)
+    return out
+
+
+def gf_matmul_u32_mxu(matrix: np.ndarray, chunks: jax.Array) -> jax.Array:
+    """Same contract as gf_matmul_u32, computed on the MXU.
+
+    GF(2^8) is linear over GF(2): slice the packed bytes into 8 bit
+    planes, multiply by the lifted (R*8, C*8) bit-matrix as ONE int8
+    systolic-array matmul with int32 accumulation, take parity (&1),
+    and repack. The SWAR kernel burns ~16 vector ops per (row, col,
+    bit) triple on the VPU; here the whole contraction runs on the
+    matrix unit and the VPU only does the bit slice/pack, which is why
+    this is the TPU-first shape for the hot encode path.
+    """
+    rows, cols = matrix.shape
+    if chunks.shape[-2] != cols:
+        raise ValueError(
+            f"chunks axis -2 is {chunks.shape[-2]}, matrix wants {cols}"
+        )
+    bm = jnp.asarray(_lift_bitmatrix(matrix))
+    x = chunks.astype(jnp.uint32)
+    lead = x.shape[:-2]
+    w = x.shape[-1]
+    # u32 words -> little-endian bytes (..., C, 4W)
+    bytes_ = jnp.stack(
+        [(x >> jnp.uint32(8 * i)) & jnp.uint32(0xFF) for i in range(4)],
+        axis=-1,
+    ).reshape(*lead, cols, 4 * w)
+    # bytes -> bit planes (..., C*8, 4W) int8; row c*8+b = bit b
+    bits = jnp.stack(
+        [(bytes_ >> jnp.uint32(b)) & jnp.uint32(1) for b in range(8)],
+        axis=-2,
+    ).reshape(*lead, cols * 8, 4 * w).astype(jnp.int8)
+    acc = jnp.einsum(
+        "rc,...cn->...rn", bm, bits,
+        preferred_element_type=jnp.int32,
+    ) & 1  # (..., R*8, 4W) parity bits
+    acc = acc.reshape(*lead, rows, 8, 4 * w).astype(jnp.uint32)
+    out_bytes = sum(
+        acc[..., b, :] << jnp.uint32(b) for b in range(8)
+    )  # (..., R, 4W)
+    # bytes -> u32 words (little-endian)
+    ob = out_bytes.reshape(*lead, rows, w, 4)
+    return (
+        ob[..., 0]
+        | (ob[..., 1] << jnp.uint32(8))
+        | (ob[..., 2] << jnp.uint32(16))
+        | (ob[..., 3] << jnp.uint32(24))
+    )
+
+
+def _lift_bitmatrix_planar(matrix: np.ndarray) -> np.ndarray:
+    """Bit-matrix with bit-major (planar) row/col order for the Pallas
+    kernel: BM2[i*R + r, j*C + c] = BM[r*8 + i, c*8 + j].
+
+    The kernel builds its bit planes by concatenating whole (C, T) planes
+    along the sublane axis (row index j*C + c) — no per-byte row
+    interleave, which Mosaic would have to do with sublane shuffles. The
+    column/row permutation is absorbed here, on the host, for free.
+    """
+    bm = _lift_bitmatrix(matrix)
+    rows, cols = matrix.shape
+    out = np.zeros((rows * 8, cols * 8), dtype=np.int8)
+    for r in range(rows):
+        for i in range(8):
+            for c in range(cols):
+                for j in range(8):
+                    out[i * rows + r, j * cols + c] = bm[r * 8 + i, c * 8 + j]
+    return out
+
+
+def _pallas_tile(w: int, max_t: int = 8192) -> int | None:
+    """Largest lane-tile <= max_t that divides W and is a multiple of 128."""
+    t = min(w, max_t)
+    while t >= 128:
+        if w % t == 0 and t % 128 == 0:
+            return t
+        t -= 128
+    return None
+
+
+def gf_matmul_pallas(matrix: np.ndarray, chunks: jax.Array,
+                     interpret: bool = False) -> jax.Array:
+    """Same contract as gf_matmul_u32, as a fused Pallas TPU kernel.
+
+    The einsum MXU path (gf_matmul_u32_mxu) materializes the int8 bit
+    planes (8x the data) and the int32 accumulator (32x the parity bits)
+    in HBM — ~50x the minimal traffic. Here each (C, T) input tile is
+    unpacked to bit planes, contracted on the MXU (bf16 x bf16 -> f32;
+    row sums <= 8C < 2^8 are exact), reduced mod 2, and repacked to
+    uint32 entirely in VMEM: HBM sees only the data in and parity out,
+    the roofline minimum. This is the TPU-native answer to the
+    reference's SIMD GF tables (ErasureCodeIsa.cc:120 ec_encode_data).
+    """
+    rows, cols = matrix.shape
+    if chunks.shape[-2] != cols:
+        raise ValueError(
+            f"chunks axis -2 is {chunks.shape[-2]}, matrix wants {cols}"
+        )
+    x = chunks.astype(jnp.uint32)
+    lead = x.shape[:-2]
+    w = x.shape[-1]
+    b = int(np.prod(lead)) if lead else 1
+    x3 = x.reshape(b, cols, w)
+    bm = jnp.asarray(_lift_bitmatrix_planar(matrix), dtype=jnp.bfloat16)
+    if interpret:
+        out = _gf_pallas_raw(x3, bm, interpret=True)
+    else:
+        out = _partitioned_gf_pallas()(x3, bm)
+    return out.reshape(*lead, rows, w)
+
+
+_PARTITIONED_GF_PALLAS = None
+
+
+def _partitioned_gf_pallas():
+    """custom_partitioning wrapper: pallas_call is opaque to GSPMD, but
+    this op is independent along the batch and word axes, so under a
+    sharded jit each device just runs the kernel on its local (b, C, w)
+    shard — zero collectives, matching parallel.chunk_batch_sharding's
+    (stripe, width) mesh layout. The chunk axis (C in, R out) and the
+    bit-matrix stay replicated."""
+    global _PARTITIONED_GF_PALLAS
+    if _PARTITIONED_GF_PALLAS is not None:
+        return _PARTITIONED_GF_PALLAS
+    from jax.experimental.custom_partitioning import custom_partitioning
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    @custom_partitioning
+    def fn(x3, bm):
+        return _gf_pallas_raw(x3, bm,
+                              interpret=jax.default_backend() != "tpu")
+
+    def _shardings(mesh, arg_shapes):
+        spec = arg_shapes[0].sharding.spec
+        b = spec[0] if len(spec) > 0 else None
+        w = spec[2] if len(spec) > 2 else None
+        x_sh = NamedSharding(mesh, PartitionSpec(b, None, w))
+        bm_sh = NamedSharding(mesh, PartitionSpec(None, None))
+        return x_sh, bm_sh
+
+    def infer(mesh, arg_shapes, result_shape):
+        return _shardings(mesh, arg_shapes)[0]
+
+    def partition(mesh, arg_shapes, result_shape):
+        x_sh, bm_sh = _shardings(mesh, arg_shapes)
+
+        def lower_fn(x3, bm):
+            return _gf_pallas_raw(x3, bm,
+                                  interpret=jax.default_backend() != "tpu")
+
+        return mesh, lower_fn, x_sh, (x_sh, bm_sh)
+
+    fn.def_partition(infer_sharding_from_operands=infer, partition=partition,
+                     sharding_rule="b c w, rr cc -> b r w")
+    _PARTITIONED_GF_PALLAS = fn
+    return fn
+
+
+def _gf_pallas_raw(x3: jax.Array, bm: jax.Array,
+                   interpret: bool = False) -> jax.Array:
+    """The pallas_call itself: x3 (B, C, W) u32, bm (8R, 8C) bf16 planar
+    bit-matrix -> (B, R, W) u32. Kept const-free (bm is an argument) so
+    custom_partitioning can wrap it for GSPMD multichip lowering; a
+    non-128-multiple W (e.g. an uneven per-shard slice) is zero-padded to
+    the next lane boundary and sliced back — GF zero rows produce zero
+    outputs, so padding is invisible."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, cols, w = x3.shape
+    rows = bm.shape[0] // 8
+    t = _pallas_tile(w)
+    if t is None:
+        wpad = -(-w // 128) * 128
+        padded = jnp.pad(x3, ((0, 0), (0, 0), (0, wpad - w)))
+        return _gf_pallas_raw(padded, bm, interpret=interpret)[..., :w]
+
+    def kernel(x_ref, bm_ref, out_ref):
+        xt = x_ref[0]  # (C, T) uint32
+        bmv = bm_ref[:]  # (8R, 8C) bfloat16
+        out = jnp.zeros((rows, t), jnp.uint32)
+        for byte in range(4):
+            xb = (xt >> jnp.uint32(8 * byte)) & jnp.uint32(0xFF)
+            bits = jnp.concatenate(
+                [(xb >> jnp.uint32(j)) & jnp.uint32(1) for j in range(8)],
+                axis=0,
+            ).astype(jnp.int32).astype(jnp.bfloat16)  # (8C, T), row j*C+c
+            # (Mosaic has no uint32->bf16 cast; int32 hop is free here)
+            prod = jnp.dot(bmv, bits, preferred_element_type=jnp.float32)
+            par = prod.astype(jnp.int32).astype(jnp.uint32) & jnp.uint32(1)
+            ob = jnp.zeros((rows, t), jnp.uint32)
+            for i in range(8):
+                ob = ob | (par[i * rows:(i + 1) * rows] << jnp.uint32(i))
+            out = out | (ob << jnp.uint32(8 * byte))
+        out_ref[0] = out
+
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((b, rows, w), jnp.uint32),
+        grid=(b, w // t),
+        in_specs=[
+            pl.BlockSpec((1, cols, t), lambda i, j: (i, 0, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((rows * 8, cols * 8), lambda i, j: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, rows, t), lambda i, j: (i, 0, j),
+                               memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(x3, bm)
+
+
+#: GF matmul implementation: "auto" (Pallas fused kernel on TPU, einsum
+#: bit-matrix on CPU), "pallas", "mxu" (einsum bit-matrix — portable but
+#: materializes bit planes in HBM), or "swar" (packed-lane shifts/xors
+#: on the VPU). All bit-exact.
+IMPL = os.environ.get("CEPH_TPU_GF_IMPL", "auto")
+
+_IMPLS = {
+    "pallas": gf_matmul_pallas,
+    "mxu": gf_matmul_u32_mxu,
+    "swar": gf_matmul_u32,
+}
+
+
+def _resolve_impl(impl: str | None) -> str:
+    impl = impl or IMPL
+    if impl == "auto":
+        return "pallas" if jax.default_backend() == "tpu" else "mxu"
+    if impl not in _IMPLS:
+        raise ValueError(
+            f"unknown GF matmul impl {impl!r} (CEPH_TPU_GF_IMPL?); "
+            f"expected one of {'auto', *sorted(_IMPLS)}"
+        )
+    return impl
+
+
 # Sized above the erasure-pattern count for supported k+m (e.g. C(11,8)=165
 # recovery matrices for k=8,m=3 before present-orderings): evicting a jitted
 # kernel costs a full XLA recompile.
 @functools.lru_cache(maxsize=4096)
-def _jit_matmul(matrix_bytes: bytes, rows: int, cols: int):
+def _jit_matmul_impl(matrix_bytes: bytes, rows: int, cols: int, impl: str):
     matrix = np.frombuffer(matrix_bytes, dtype=np.uint8).reshape(rows, cols)
-    return jax.jit(functools.partial(gf_matmul_u32, matrix))
+    return jax.jit(functools.partial(_IMPLS[impl], matrix))
 
 
-def jit_gf_matmul(matrix: np.ndarray):
+def jit_gf_matmul(matrix: np.ndarray, impl: str | None = None):
     """Cached jitted GF matmul specialized to a host coding matrix."""
     m = np.ascontiguousarray(matrix, dtype=np.uint8)
-    return _jit_matmul(m.tobytes(), m.shape[0], m.shape[1])
+    return _jit_matmul_impl(m.tobytes(), m.shape[0], m.shape[1],
+                            _resolve_impl(impl))
+
+
+def gf_matmul(matrix: np.ndarray, chunks: jax.Array,
+              impl: str | None = None) -> jax.Array:
+    """Traceable GF matmul dispatching on the configured backend (for
+    use inside larger jitted programs like datapath.write_step)."""
+    return _IMPLS[_resolve_impl(impl)](matrix, chunks)
 
 
 def encode(matrix: np.ndarray, data: jax.Array) -> jax.Array:
