@@ -34,7 +34,9 @@ class _LeaderRef:
 class TestCluster:
     def __init__(self, n_osds: int = 5, hb_grace: float = 2.0,
                  out_interval: float = 4.0, hb_interval: float = 0.15,
-                 crush: cm.CrushMap | None = None, n_mons: int = 1):
+                 crush: cm.CrushMap | None = None, n_mons: int = 1,
+                 objectstore: str = "memstore",
+                 data_dir: str | None = None, **store_kw):
         self.bus = LocalBus()
         self.n_osds = n_osds
         self.n_mons = n_mons
@@ -53,7 +55,17 @@ class TestCluster:
             self._mon = MonLite(self.bus, n_osds, crush=crush,
                                 hb_grace=hb_grace,
                                 out_interval=out_interval)
-        self.stores = [MemStore() for _ in range(n_osds)]
+        if objectstore == "memstore":
+            self.stores = [MemStore() for _ in range(n_osds)]
+        else:  # vstart.sh --bluestore role: one store dir per OSD
+            from .. import store as store_mod
+
+            assert data_dir is not None, "durable stores need data_dir"
+            self.stores = [
+                store_mod.create(objectstore, f"{data_dir}/osd.{i}",
+                                 **store_kw)
+                for i in range(n_osds)
+            ]
         self.osds: list[OSDLite | None] = [None] * n_osds
         self.hb_interval = hb_interval
         self.mgr = MgrLite(self.bus, _LeaderRef(self))
@@ -96,17 +108,21 @@ class TestCluster:
             self.mons[rank] = None
 
     async def stop(self) -> None:
-        await self.client.close()
-        for i, osd in enumerate(self.osds):
-            if osd is not None:
-                await osd.stop()
-                self.osds[i] = None
-        await self.mgr.stop()
-        if self._mon is not None:
-            await self._mon.stop()
-        for m in self.mons:
-            if m is not None:
-                await m.stop()
+        try:
+            await self.client.close()
+            for i, osd in enumerate(self.osds):
+                if osd is not None:
+                    await osd.stop()
+                    self.osds[i] = None
+            await self.mgr.stop()
+            if self._mon is not None:
+                await self._mon.stop()
+            for m in self.mons:
+                if m is not None:
+                    await m.stop()
+        finally:  # a failed daemon stop must not leak mounted stores
+            for s in self.stores:
+                s.umount()
 
     async def start_osd(self, i: int) -> OSDLite:
         osd = OSDLite(self.bus, i, store=self.stores[i],

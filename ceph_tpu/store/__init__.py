@@ -11,7 +11,13 @@ Implementations:
   src/os/memstore role; used by OSD-lite processes and tests.
 - ``WalStore`` (walstore.py) — persistent directory-backed store with a
   CRC-framed write-ahead log, checkpoint snapshots, and batched CRC32C
-  blob checksums through the Checksummer (the BlueStore-shaped store).
+  blob checksums through the Checksummer (a FileStore-shaped middle
+  tier: whole-store snapshots, data in the checkpoint file).
+- ``BlueStoreLite`` (bluestore.py) — the BlueStore role proper: object
+  data in 4 KiB blocks on a raw block device (native C++ IO thread
+  pool, src/blk role) placed by a native bitmap allocator, metadata in
+  the native embedded KV (src/kv role), COW writes, per-block crc32c
+  verified on read.
 
 Factory: ``create(kind, path)`` mirroring ObjectStore::create
 (src/os/ObjectStore.cc:30-62).
@@ -27,10 +33,16 @@ def create(kind: str, path: str | None = None, **kw) -> ObjectStore:
     """ObjectStore::create-style factory (os/ObjectStore.cc:30)."""
     if kind == "memstore":
         return MemStore()
-    if kind in ("walstore", "filestore", "bluestore"):
+    if kind in ("walstore", "filestore"):
         from .walstore import WalStore
 
         s = WalStore(path, **kw)
+        s.mount()
+        return s
+    if kind == "bluestore":
+        from .bluestore import BlueStoreLite
+
+        s = BlueStoreLite(path, **kw)
         s.mount()
         return s
     raise ValueError(f"unknown store kind {kind!r}")

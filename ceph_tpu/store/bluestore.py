@@ -1,0 +1,678 @@
+"""BlueStoreLite: block-device ObjectStore with KV metadata (the
+BlueStore role proper, src/os/bluestore/BlueStore.cc).
+
+Layout, mirroring the reference's split of labor:
+- object DATA lives on a raw block device (ceph_tpu.native.rt
+  BlockDevice — the src/blk KernelDevice role) in 4 KiB blocks handed
+  out by a native bitmap allocator (fastbmap_allocator_impl role);
+- all METADATA (onodes: size + block map + per-block crc32c + xattrs;
+  omap key/values; collection markers) lives in the native embedded KV
+  (RocksDB's job), under BlueStore-style escaped composite keys.
+
+Transaction lifecycle is the txc state machine
+(BlueStore.cc:12636 _txc_state_proc) in miniature:
+  PREPARE    ops interpreted against shadow onodes; every data write is
+             COW — fresh blocks from the allocator, old blocks kept;
+  AIO_WAIT   staged blocks go to the device through the IO thread pool,
+             then a drain (+fdatasync when fsync=True) barrier;
+  KV_SUBMIT  ONE atomic kv batch commits every metadata mutation — this
+             batch is the commit point;
+  FINISH     shadow swapped in, superseded blocks released, on_commit.
+A crash at any point leaves the previous committed state intact: data
+blocks written before the kv commit are unreferenced garbage that the
+mount-time allocator rebuild (from committed block maps) reclaims.
+
+Checksums follow bluestore_blob_t::calc_csum/verify_csum
+(bluestore_types.cc:737,763): staged blocks are checksummed in ONE
+batched Checksummer call per transaction (device=True routes it through
+the TPU crc32c kernel), and every read verifies its blocks in one
+batched call (_verify_csum role, BlueStore.cc:11277).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable
+
+import numpy as np
+
+from ..checksum import Checksummer
+from ..native import rt
+from ..utils import denc
+from . import transaction as tx
+from .base import NotFound, ObjectStore, StoreError
+
+BLOCK = 4096
+HOLE = 0xFFFFFFFF  # block-map entry for an unallocated (all-zero) block
+SEP = b"\x00\x00"
+
+K_COLL = b"C"
+K_ONODE = b"O"
+K_OMAP = b"M"
+K_HEAD = b"H"
+
+_ZERO_BLOCK = bytes(BLOCK)
+
+
+def _esc(b: bytes) -> bytes:
+    """NUL-escape so SEP (double NUL) can't occur inside a component —
+    the same trick BlueStore's key encoding uses."""
+    return b.replace(b"\x00", b"\x00\x01")
+
+
+def _okey(cid: str, oid: bytes) -> bytes:
+    return _esc(cid.encode()) + SEP + _esc(oid)
+
+
+class Onode:
+    """Per-object metadata: size, 4K block map, per-block crc32c,
+    xattrs, omap (omap is authoritative in kv; cached here)."""
+
+    __slots__ = ("size", "blocks", "csums", "xattrs", "omap", "omap_header")
+
+    def __init__(self):
+        self.size = 0
+        self.blocks: list[int] = []
+        self.csums: list[int] = []
+        self.xattrs: dict[str, bytes] = {}
+        self.omap: dict[bytes, bytes] = {}
+        self.omap_header = b""
+
+    def clone_meta(self) -> "Onode":
+        o = Onode()
+        o.size = self.size
+        o.blocks = list(self.blocks)
+        o.csums = list(self.csums)
+        o.xattrs = dict(self.xattrs)
+        o.omap = dict(self.omap)
+        o.omap_header = self.omap_header
+        return o
+
+    def encode(self) -> bytes:
+        return b"".join([
+            denc.enc_u64(self.size),
+            denc.enc_list(self.blocks, denc.enc_u32),
+            denc.enc_list(self.csums, denc.enc_u32),
+            denc.enc_map(self.xattrs, denc.enc_str, denc.enc_bytes),
+        ])
+
+    @classmethod
+    def decode(cls, buf: bytes) -> "Onode":
+        o = cls()
+        o.size, off = denc.dec_u64(buf, 0)
+        o.blocks, off = denc.dec_list(buf, off, denc.dec_u32)
+        o.csums, off = denc.dec_list(buf, off, denc.dec_u32)
+        o.xattrs, off = denc.dec_map(buf, off, denc.dec_str, denc.dec_bytes)
+        return o
+
+
+class _CollView:
+    """Dict-like overlay over one committed collection: reads fall
+    through to the committed dict, writes stay in the overlay until
+    commit (None = deleted). Keeps staging O(ops touched), not
+    O(objects in the PG)."""
+
+    def __init__(self, committed: dict[bytes, Onode] | None):
+        self.committed = committed if committed is not None else {}
+        self.overlay: dict[bytes, Onode | None] = {}
+
+    def get(self, oid: bytes) -> Onode | None:
+        if oid in self.overlay:
+            return self.overlay[oid]
+        return self.committed.get(oid)
+
+    def __contains__(self, oid: bytes) -> bool:
+        return self.get(oid) is not None
+
+    def __getitem__(self, oid: bytes) -> Onode:
+        o = self.get(oid)
+        if o is None:
+            raise KeyError(oid)
+        return o
+
+    def __setitem__(self, oid: bytes, o: Onode) -> None:
+        self.overlay[oid] = o
+
+    def pop(self, oid: bytes) -> Onode:
+        o = self[oid]
+        self.overlay[oid] = None
+        return o
+
+    def __iter__(self):
+        for oid in self.committed:
+            if self.overlay.get(oid, ...) is not None:
+                yield oid
+        for oid, o in self.overlay.items():
+            if o is not None and oid not in self.committed:
+                yield oid
+
+    def empty(self) -> bool:
+        return next(iter(self), None) is None
+
+
+class _Txc:
+    """Staging state of one transaction (the txc)."""
+
+    def __init__(self, store: "BlueStoreLite"):
+        self.store = store
+        self.views: dict[str, _CollView] = {}  # touched collections
+        self.staged: dict[int, bytes] = {}  # new phys block -> contents
+        self.new_blocks: list[int] = []     # rollback set
+        self.freed: list[int] = []          # release after commit
+        self.dirty: set[tuple[str, bytes]] = set()
+        self.coll_added: set[str] = set()
+        self.coll_removed: set[str] = set()
+        # ids of onodes created or cloned by THIS txn — safe to mutate.
+        # (An identity check against the committed dict is not enough:
+        # split/merge move committed Onode objects between collections.)
+        self.private: set[int] = set()
+
+    # ------------------------------------------------------------ helpers
+
+    def coll(self, cid: str) -> _CollView:
+        v = self.views.get(cid)
+        if v is not None:
+            return v
+        if cid in self.coll_removed or cid not in self.store.colls:
+            raise NotFound(f"collection {cid}")
+        v = _CollView(self.store.colls[cid])
+        self.views[cid] = v
+        return v
+
+    def onode(self, cid: str, oid: bytes, create: bool) -> Onode:
+        c = self.coll(cid)
+        o = c.get(oid)
+        if o is None:
+            if not create:
+                raise NotFound(repr(oid))
+            o = Onode()
+            self.private.add(id(o))
+            c[oid] = o
+        elif id(o) not in self.private:
+            o = o.clone_meta()  # copy-on-first-mutation
+            self.private.add(id(o))
+            c[oid] = o
+        self.dirty.add((cid, oid))
+        return o
+
+    def alloc_block(self, data: bytes) -> int:
+        try:
+            phys = self.store.alloc.alloc(1)
+        except MemoryError as e:
+            raise StoreError(f"ENOSPC: {e}") from e
+        self.new_blocks.append(phys)
+        self.staged[phys] = data
+        return phys
+
+    def block_bytes(self, onode: Onode, bi: int) -> bytes:
+        """Current contents of logical block bi (staged, device, hole)."""
+        if bi >= len(onode.blocks) or onode.blocks[bi] == HOLE:
+            return _ZERO_BLOCK
+        phys = onode.blocks[bi]
+        if phys in self.staged:
+            return self.staged[phys]
+        return self.store.dev.pread(phys * BLOCK, BLOCK)
+
+    def reassign(self, onode: Onode, bi: int, data: bytes) -> None:
+        old = onode.blocks[bi]
+        if old != HOLE:
+            self.freed.append(old)
+        onode.blocks[bi] = self.alloc_block(data)
+        onode.csums[bi] = 0  # filled from the batched csum at commit
+
+    def punch(self, onode: Onode, bi: int) -> None:
+        old = onode.blocks[bi]
+        if old != HOLE:
+            self.freed.append(old)
+        onode.blocks[bi] = HOLE
+        onode.csums[bi] = 0
+
+    def grow(self, onode: Onode, size: int) -> None:
+        nb = -(-size // BLOCK)
+        while len(onode.blocks) < nb:
+            onode.blocks.append(HOLE)
+            onode.csums.append(0)
+
+    # ----------------------------------------------------------- data ops
+
+    def write_range(self, onode: Onode, offset: int, data: bytes) -> None:
+        if not data:
+            onode.size = max(onode.size, offset)
+            self.grow(onode, onode.size)
+            return
+        end = offset + len(data)
+        self.grow(onode, max(end, onode.size))
+        for bi in range(offset // BLOCK, -(-end // BLOCK)):
+            b0 = bi * BLOCK
+            lo, hi = max(offset, b0), min(end, b0 + BLOCK)
+            piece = data[lo - offset:hi - offset]
+            if hi - lo == BLOCK:
+                nd = piece
+            else:
+                old = self.block_bytes(onode, bi)
+                nd = old[:lo - b0] + piece + old[hi - b0:]
+            self.reassign(onode, bi, nd)
+        onode.size = max(onode.size, end)
+
+    def zero_range(self, onode: Onode, offset: int, length: int) -> None:
+        end = offset + length
+        self.grow(onode, max(end, onode.size))
+        for bi in range(offset // BLOCK, -(-end // BLOCK)):
+            b0 = bi * BLOCK
+            lo, hi = max(offset, b0), min(end, b0 + BLOCK)
+            if hi - lo == BLOCK:
+                self.punch(onode, bi)
+            else:
+                old = self.block_bytes(onode, bi)
+                nd = old[:lo - b0] + b"\x00" * (hi - lo) + old[hi - b0:]
+                self.reassign(onode, bi, nd)
+        onode.size = max(onode.size, end)
+
+    def truncate(self, onode: Onode, size: int) -> None:
+        if size < onode.size:
+            nb = -(-size // BLOCK)
+            for bi in range(nb, len(onode.blocks)):
+                if onode.blocks[bi] != HOLE:
+                    self.freed.append(onode.blocks[bi])
+            del onode.blocks[nb:]
+            del onode.csums[nb:]
+            tail = size % BLOCK
+            if tail and nb and onode.blocks[nb - 1] != HOLE:
+                # stale bytes past size must read zero if re-extended
+                old = self.block_bytes(onode, nb - 1)
+                self.reassign(onode, nb - 1, old[:tail] + b"\x00" * (BLOCK - tail))
+        onode.size = size
+        self.grow(onode, size)
+
+    def read_range(self, onode: Onode, offset: int, length: int) -> bytes:
+        end = min(onode.size, offset + length)
+        if offset >= end:
+            return b""
+        parts = []
+        for bi in range(offset // BLOCK, -(-end // BLOCK)):
+            b0 = bi * BLOCK
+            parts.append(self.block_bytes(onode, bi)[
+                max(offset, b0) - b0:min(end, b0 + BLOCK) - b0])
+        return b"".join(parts)
+
+    # ------------------------------------------------------ op interpreter
+
+    def _coll_exists(self, cid: str) -> bool:
+        if cid in self.views:
+            return True
+        return cid not in self.coll_removed and cid in self.store.colls
+
+    def _drop_coll(self, cid: str) -> None:
+        self.views.pop(cid, None)
+        self.coll_removed.add(cid)
+        self.coll_added.discard(cid)
+
+    def apply(self, op: tx.Op) -> None:
+        code, cid, oid, a = op.code, op.cid, op.oid, op.args
+        if code == tx.OP_MKCOLL:
+            if self._coll_exists(cid):
+                raise StoreError(f"collection {cid} exists")
+            self.views[cid] = _CollView(None)
+            self.coll_added.add(cid)
+            return
+        if code == tx.OP_RMCOLL:
+            c = self.coll(cid)
+            if not c.empty():
+                raise StoreError(f"collection {cid} not empty")
+            self._drop_coll(cid)
+            return
+        if code == tx.OP_SPLIT_COLL:
+            src, dest = self.coll(cid), self.coll(a["dest_cid"])
+            mask = (1 << a["bits"]) - 1
+            from ..placement.osdmap import ceph_str_hash_rjenkins
+
+            moving = [o for o in src
+                      if ceph_str_hash_rjenkins(o) & mask == a["rem"]]
+            for o in moving:
+                dest[o] = src.pop(o)
+                self.dirty.add((cid, o))
+                self.dirty.add((a["dest_cid"], o))
+            return
+        if code == tx.OP_MERGE_COLL:
+            src, dest = self.coll(cid), self.coll(a["dest_cid"])
+            for o in list(src):
+                dest[o] = src.pop(o)
+                self.dirty.add((cid, o))
+                self.dirty.add((a["dest_cid"], o))
+            self._drop_coll(cid)
+            return
+        if code == tx.OP_TOUCH:
+            self.onode(cid, oid, create=True)
+            return
+        if code == tx.OP_REMOVE:
+            c = self.coll(cid)
+            if oid not in c:
+                raise NotFound(repr(oid))
+            o = c.pop(oid)
+            self.freed.extend(b for b in o.blocks if b != HOLE)
+            self.dirty.add((cid, oid))
+            return
+        if code == tx.OP_CLONE:
+            c = self.coll(cid)
+            if oid not in c:
+                raise NotFound(repr(oid))
+            src = c[oid]
+            if a["dest"] in c:  # clobbered clone target: free old blocks
+                self.freed.extend(
+                    b for b in c[a["dest"]].blocks if b != HOLE)
+            dst = Onode()
+            dst.size = src.size
+            dst.xattrs = dict(src.xattrs)
+            dst.omap = dict(src.omap)
+            dst.omap_header = src.omap_header
+            for bi, phys in enumerate(src.blocks):
+                if phys == HOLE:
+                    dst.blocks.append(HOLE)
+                    dst.csums.append(0)
+                else:  # eager copy (block sharing + refcounts: future)
+                    dst.blocks.append(self.alloc_block(
+                        self.block_bytes(src, bi)))
+                    dst.csums.append(0)
+            c[a["dest"]] = dst
+            self.dirty.add((cid, a["dest"]))
+            return
+        if code == tx.OP_CLONERANGE:
+            c = self.coll(cid)
+            if oid not in c:
+                raise NotFound(repr(oid))
+            data = self.read_range(c[oid], a["src_off"], a["length"])
+            dst = self.onode(cid, a["dest"], create=True)
+            self.write_range(dst, a["dst_off"], data)
+            return
+
+        create = code in (
+            tx.OP_WRITE, tx.OP_ZERO, tx.OP_TRUNCATE, tx.OP_SETATTR,
+            tx.OP_SETATTRS, tx.OP_OMAP_SETKEYS, tx.OP_OMAP_SETHEADER,
+            tx.OP_SETALLOCHINT,
+        )
+        o = self.onode(cid, oid, create=create)
+        if code == tx.OP_WRITE:
+            self.write_range(o, a["offset"], a["data"])
+        elif code == tx.OP_ZERO:
+            self.zero_range(o, a["offset"], a["length"])
+        elif code == tx.OP_TRUNCATE:
+            self.truncate(o, a["size"])
+        elif code == tx.OP_SETATTR:
+            o.xattrs[a["name"]] = a["value"]
+        elif code == tx.OP_SETATTRS:
+            o.xattrs.update(a["attrs"])
+        elif code == tx.OP_RMATTR:
+            o.xattrs.pop(a["name"], None)
+        elif code == tx.OP_RMATTRS:
+            o.xattrs.clear()
+        elif code == tx.OP_OMAP_CLEAR:
+            o.omap.clear()
+        elif code == tx.OP_OMAP_SETKEYS:
+            o.omap.update(a["kv"])
+        elif code == tx.OP_OMAP_RMKEYS:
+            for k in a["keys"]:
+                o.omap.pop(k, None)
+        elif code == tx.OP_OMAP_RMKEYRANGE:
+            for k in [k for k in o.omap if a["first"] <= k < a["last"]]:
+                del o.omap[k]
+        elif code == tx.OP_OMAP_SETHEADER:
+            o.omap_header = a["header"]
+        elif code == tx.OP_SETALLOCHINT:
+            o.xattrs["_alloc_hint"] = (
+                a["expected_object_size"].to_bytes(8, "little")
+                + a["expected_write_size"].to_bytes(8, "little")
+                + a["flags"].to_bytes(4, "little"))
+        else:
+            raise StoreError(f"unknown op {code}")
+
+
+class BlueStoreLite(ObjectStore):
+    def __init__(self, path: str, size: int = 1 << 30, fsync: bool = False,
+                 device_csum: bool = False, io_threads: int = 4,
+                 kv_compact_bytes: int = 64 << 20):
+        self.path = str(path)
+        self.dev_size = size
+        self.fsync = fsync
+        self.device_csum = device_csum
+        self.io_threads = io_threads
+        self.kv_compact_bytes = kv_compact_bytes
+        self.kv: rt.NativeKV | None = None
+        self.dev: rt.BlockDevice | None = None
+        self.alloc: rt.BitmapAllocator | None = None
+        self.colls: dict[str, dict[bytes, Onode]] = {}
+        self.lock = threading.RLock()
+        self._csum = Checksummer(alg="crc32c", csum_block_size=BLOCK)
+        self._mounted = False
+
+    # ---------------------------------------------------------- lifecycle
+
+    def mount(self) -> None:
+        import os
+
+        os.makedirs(self.path, exist_ok=True)
+        self.kv = rt.NativeKV(os.path.join(self.path, "kv"),
+                              fsync=self.fsync)
+        self.dev = rt.BlockDevice(os.path.join(self.path, "block"),
+                                  self.dev_size, self.io_threads)
+        self.alloc = rt.BitmapAllocator(self.dev.size // BLOCK)
+        self.colls = {}
+        for k, _ in self.kv.scan_prefix(K_COLL):
+            cid = k[1:].replace(b"\x00\x01", b"\x00").decode()
+            self.colls[cid] = {}
+        for k, v in self.kv.scan_prefix(K_ONODE):
+            cid, oid = self._split_okey(k[1:])
+            o = Onode.decode(v)
+            self.colls.setdefault(cid, {})[oid] = o
+            for phys in o.blocks:  # allocator rebuild reclaims orphans
+                if phys != HOLE:
+                    self.alloc.mark_used(phys, 1)
+        for k, v in self.kv.scan_prefix(K_HEAD):
+            cid, oid = self._split_okey(k[1:])
+            if cid in self.colls and oid in self.colls[cid]:
+                self.colls[cid][oid].omap_header = v
+        for k, v in self.kv.scan_prefix(K_OMAP):
+            cid, oid, okey = self._split_omap_key(k[1:])
+            if cid in self.colls and oid in self.colls[cid]:
+                self.colls[cid][oid].omap[okey] = v
+        self._mounted = True
+
+    @staticmethod
+    def _split_okey(rest: bytes) -> tuple[str, bytes]:
+        cid_e, oid_e = rest.split(SEP, 1)
+        return (cid_e.replace(b"\x00\x01", b"\x00").decode(),
+                oid_e.replace(b"\x00\x01", b"\x00"))
+
+    @staticmethod
+    def _split_omap_key(rest: bytes) -> tuple[str, bytes, bytes]:
+        cid_e, r = rest.split(SEP, 1)
+        oid_e, okey = r.split(SEP, 1)
+        return (cid_e.replace(b"\x00\x01", b"\x00").decode(),
+                oid_e.replace(b"\x00\x01", b"\x00"), okey)
+
+    def umount(self) -> None:
+        if not self._mounted:
+            return
+        self.kv.compact()
+        self.kv.close()
+        self.dev.close()
+        self.alloc.close()
+        self._mounted = False
+
+    # ------------------------------------------------------------- writes
+
+    def queue_transaction(
+        self, t: tx.Transaction, on_commit: Callable[[], None] | None = None
+    ) -> None:
+        if not self._mounted:
+            raise StoreError("not mounted")
+        with self.lock:
+            txc = _Txc(self)
+            try:
+                for op in t.ops:  # PREPARE
+                    txc.apply(op)
+            except BaseException:
+                for phys in txc.new_blocks:
+                    self.alloc.release(phys, 1)
+                raise
+            self._commit(txc)
+        if on_commit:
+            on_commit()
+        if self.kv.wal_size() >= self.kv_compact_bytes:
+            self.kv.compact()
+
+    def _commit(self, txc: _Txc) -> None:
+        # batched checksums of every staged block (calc_csum role)
+        phys_list = sorted(txc.staged)
+        if phys_list:
+            blocks = np.frombuffer(
+                b"".join(txc.staged[p] for p in phys_list), np.uint8
+            ).reshape(len(phys_list), BLOCK)
+            crcs = self._csum.calculate(blocks, device=self.device_csum)
+            crc_of = {p: int(c) for p, c in zip(phys_list, crcs)}
+            for cid, oid in txc.dirty:
+                v = txc.views.get(cid)
+                o = v.get(oid) if v is not None else None
+                if o is None:
+                    continue
+                for bi, phys in enumerate(o.blocks):
+                    if phys in crc_of:
+                        o.csums[bi] = crc_of[phys]
+            # AIO_WAIT: data must be on the device before the kv commit
+            for p in phys_list:
+                self.dev.submit_write(p * BLOCK, txc.staged[p])
+            if self.fsync:
+                self.dev.flush()
+            else:
+                self.dev.drain()
+
+        # KV_SUBMIT: one atomic batch = the commit point
+        ops: list[tuple[str, bytes, bytes | None]] = []
+        for cid in txc.coll_removed:
+            ops.append(("del", K_COLL + _esc(cid.encode()), None))
+        for cid in txc.coll_added:
+            ops.append(("put", K_COLL + _esc(cid.encode()), b""))
+        for cid, oid in sorted(txc.dirty):
+            key = _okey(cid, oid)
+            old = (self.colls.get(cid) or {}).get(oid)
+            v = txc.views.get(cid)
+            new = v.get(oid) if v is not None else None
+            if new is None:
+                if old is not None:
+                    ops.append(("del", K_ONODE + key, None))
+                    if old.omap_header:
+                        ops.append(("del", K_HEAD + key, None))
+                    for k in old.omap:
+                        ops.append(("del", K_OMAP + key + SEP + k, None))
+                continue
+            ops.append(("put", K_ONODE + key, new.encode()))
+            old_hdr = old.omap_header if old is not None else b""
+            if new.omap_header != old_hdr:
+                if new.omap_header:
+                    ops.append(("put", K_HEAD + key, new.omap_header))
+                elif old_hdr:
+                    ops.append(("del", K_HEAD + key, None))
+            old_omap = old.omap if old is not None else {}
+            if new.omap is not old_omap:
+                for k in old_omap:
+                    if k not in new.omap:
+                        ops.append(("del", K_OMAP + key + SEP + k, None))
+                for k, v in new.omap.items():
+                    if old_omap.get(k) != v:
+                        ops.append(("put", K_OMAP + key + SEP + k, v))
+        if ops or txc.dirty or txc.coll_added or txc.coll_removed:
+            self.kv.batch(ops or [("put", b"\x00noop", b"")])
+
+        # FINISH: fold the overlay into the live maps — O(ops), not
+        # O(objects in the PG)
+        for cid in txc.coll_removed:
+            self.colls.pop(cid, None)
+        for cid in txc.coll_added:
+            self.colls[cid] = {}
+        for cid, v in txc.views.items():
+            tgt = self.colls.get(cid)
+            if tgt is None:
+                continue
+            for oid, o in v.overlay.items():
+                if o is None:
+                    tgt.pop(oid, None)
+                else:
+                    tgt[oid] = o
+        for phys in txc.freed:
+            self.alloc.release(phys, 1)
+
+    # -------------------------------------------------------------- reads
+
+    def _onode(self, cid: str, oid: bytes) -> Onode:
+        c = self.colls.get(cid)
+        if c is None:
+            raise NotFound(f"collection {cid}")
+        o = c.get(oid)
+        if o is None:
+            raise NotFound(repr(oid))
+        return o
+
+    def read(self, cid: str, oid: bytes, offset: int = 0,
+             length: int = -1) -> bytes:
+        with self.lock:
+            o = self._onode(cid, oid)
+            end = o.size if length < 0 else min(o.size, offset + length)
+            if offset >= end:
+                return b""
+            lo_b, hi_b = offset // BLOCK, -(-end // BLOCK)
+            idx = [bi for bi in range(lo_b, hi_b)
+                   if bi < len(o.blocks) and o.blocks[bi] != HOLE]
+            datas = {bi: self.dev.pread(o.blocks[bi] * BLOCK, BLOCK)
+                     for bi in idx}
+            if idx:  # batched verify_csum (BlueStore.cc:11277 role)
+                arr = np.frombuffer(
+                    b"".join(datas[bi] for bi in idx), np.uint8
+                ).reshape(len(idx), BLOCK)
+                got = self._csum.calculate(arr, device=self.device_csum)
+                want = np.array([o.csums[bi] for bi in idx], np.uint32)
+                bad = np.nonzero(got != want)[0]
+                if bad.size:
+                    bi = idx[int(bad[0])]
+                    raise StoreError(
+                        f"csum mismatch on {cid}/{oid!r} block {bi}: "
+                        f"stored {o.csums[bi]:#x} != actual "
+                        f"{int(got[int(bad[0])]):#x}")
+            parts = []
+            for bi in range(lo_b, hi_b):
+                b0 = bi * BLOCK
+                blkdata = datas.get(bi, _ZERO_BLOCK)
+                parts.append(blkdata[max(offset, b0) - b0:
+                                     min(end, b0 + BLOCK) - b0])
+            return b"".join(parts)
+
+    def stat(self, cid: str, oid: bytes) -> int:
+        with self.lock:
+            return self._onode(cid, oid).size
+
+    def getattr(self, cid: str, oid: bytes, name: str) -> bytes:
+        with self.lock:
+            attrs = self._onode(cid, oid).xattrs
+            if name not in attrs:
+                raise NotFound(name)
+            return attrs[name]
+
+    def getattrs(self, cid: str, oid: bytes) -> dict[str, bytes]:
+        with self.lock:
+            return dict(self._onode(cid, oid).xattrs)
+
+    def omap_get(self, cid: str, oid: bytes) -> dict[bytes, bytes]:
+        with self.lock:
+            return dict(self._onode(cid, oid).omap)
+
+    def omap_get_header(self, cid: str, oid: bytes) -> bytes:
+        with self.lock:
+            return self._onode(cid, oid).omap_header
+
+    def list_collections(self) -> list[str]:
+        with self.lock:
+            return sorted(self.colls)
+
+    def list_objects(self, cid: str) -> list[bytes]:
+        with self.lock:
+            c = self.colls.get(cid)
+            if c is None:
+                raise NotFound(f"collection {cid}")
+            return sorted(c)

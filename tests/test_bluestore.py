@@ -1,0 +1,350 @@
+"""BlueStoreLite: the StoreTest conformance suite against the real
+block-device + KV store, plus BlueStore-specific behaviors the
+reference tests pin (src/test/objectstore/store_test.cc): crash-reopen
+durability, csum detection of device bit rot, COW crash atomicity,
+allocator accounting, ENOSPC."""
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from ceph_tpu.store import NotFound, StoreError
+from ceph_tpu.store import transaction as tx
+from ceph_tpu.store.bluestore import BLOCK, HOLE, BlueStoreLite
+
+from test_store import all_op_txn, check_all_op_state
+
+
+def make_store(tmp_path, **kw) -> BlueStoreLite:
+    kw.setdefault("size", 32 << 20)
+    s = BlueStoreLite(str(tmp_path / "bs"), **kw)
+    s.mount()
+    return s
+
+
+def test_all_opcodes(tmp_path):
+    s = make_store(tmp_path)
+    s.apply_transaction(all_op_txn())
+    check_all_op_state(s)
+    s.umount()
+
+
+def test_all_opcodes_survive_remount(tmp_path):
+    s = make_store(tmp_path)
+    s.apply_transaction(all_op_txn())
+    s.umount()
+    s2 = make_store(tmp_path)
+    check_all_op_state(s2)
+    s2.umount()
+
+
+def test_crash_reopen_without_umount(tmp_path):
+    """SIGKILL equivalent: no umount/compact; mount replays the kv WAL."""
+    s = make_store(tmp_path)
+    s.apply_transaction(all_op_txn())
+    t = tx.Transaction().create_collection("c2")
+    t.write("c2", b"late", 0, b"only in the wal")
+    s.apply_transaction(t)
+    s2 = make_store(tmp_path)
+    check_all_op_state(s2, extra_colls=["c2"])
+    assert s2.read("c2", b"late") == b"only in the wal"
+    s2.umount()
+
+
+def test_atomicity_rolls_back_data_and_blocks(tmp_path):
+    s = make_store(tmp_path)
+    t = tx.Transaction().create_collection("c")
+    t.write("c", b"a", 0, b"first" * 1000)
+    s.apply_transaction(t)
+    used0 = s.alloc.used
+    bad = tx.Transaction()
+    bad.write("c", b"a", 0, b"SECOND" * 2000)
+    bad.remove("c", b"ghost")  # fails -> whole txn rolls back
+    with pytest.raises(NotFound):
+        s.queue_transaction(bad)
+    assert s.read("c", b"a") == b"first" * 1000
+    assert s.alloc.used == used0  # staged COW blocks were released
+    s.umount()
+
+
+def test_cow_remove_releases_blocks(tmp_path):
+    s = make_store(tmp_path)
+    t = tx.Transaction().create_collection("c")
+    t.write("c", b"big", 0, os.urandom(40 * BLOCK))
+    s.apply_transaction(t)
+    used = s.alloc.used
+    assert used >= 40
+    s.apply_transaction(tx.Transaction().remove("c", b"big"))
+    assert s.alloc.used == used - 40
+    s.umount()
+
+
+def test_overwrite_is_cow(tmp_path):
+    """Overwriting reallocates; the superseded block is freed after
+    commit so total usage stays flat."""
+    s = make_store(tmp_path)
+    t = tx.Transaction().create_collection("c")
+    t.write("c", b"a", 0, b"x" * BLOCK)
+    s.apply_transaction(t)
+    used = s.alloc.used
+    phys0 = s.colls["c"][b"a"].blocks[0]
+    s.apply_transaction(tx.Transaction().write("c", b"a", 0, b"y" * BLOCK))
+    assert s.colls["c"][b"a"].blocks[0] != phys0
+    assert s.alloc.used == used
+    assert s.read("c", b"a") == b"y" * BLOCK
+    s.umount()
+
+
+def test_partial_block_rmw(tmp_path):
+    s = make_store(tmp_path)
+    data = os.urandom(3 * BLOCK + 777)
+    t = tx.Transaction().create_collection("c")
+    t.write("c", b"a", 0, data)
+    s.apply_transaction(t)
+    patch = os.urandom(100)
+    s.apply_transaction(
+        tx.Transaction().write("c", b"a", BLOCK + 17, patch))
+    want = bytearray(data)
+    want[BLOCK + 17:BLOCK + 117] = patch
+    assert s.read("c", b"a") == bytes(want)
+    # unaligned sub-reads
+    assert s.read("c", b"a", 1000, 5000) == bytes(want[1000:6000])
+    s.umount()
+
+
+def test_zero_punches_holes(tmp_path):
+    s = make_store(tmp_path)
+    t = tx.Transaction().create_collection("c")
+    t.write("c", b"a", 0, b"q" * (4 * BLOCK))
+    s.apply_transaction(t)
+    used = s.alloc.used
+    s.apply_transaction(tx.Transaction().zero("c", b"a", BLOCK, 2 * BLOCK))
+    assert s.alloc.used == used - 2  # full blocks became holes
+    o = s.colls["c"][b"a"]
+    assert o.blocks[1] == HOLE and o.blocks[2] == HOLE
+    assert s.read("c", b"a") == (
+        b"q" * BLOCK + b"\x00" * (2 * BLOCK) + b"q" * BLOCK)
+    s.umount()
+
+
+def test_truncate_zeroes_stale_tail(tmp_path):
+    s = make_store(tmp_path)
+    t = tx.Transaction().create_collection("c")
+    t.write("c", b"a", 0, b"z" * 3000)
+    t.truncate("c", b"a", 1000)
+    t.truncate("c", b"a", 2000)  # re-extend within the same block
+    s.apply_transaction(t)
+    assert s.read("c", b"a") == b"z" * 1000 + b"\x00" * 1000
+    s.umount()
+
+
+def test_csum_detects_device_bit_rot(tmp_path):
+    s = make_store(tmp_path)
+    t = tx.Transaction().create_collection("c")
+    t.write("c", b"a", 0, b"R" * (2 * BLOCK))
+    s.apply_transaction(t)
+    phys = s.colls["c"][b"a"].blocks[1]
+    s.umount()
+    with open(tmp_path / "bs" / "block", "r+b") as f:
+        f.seek(phys * BLOCK + 123)
+        f.write(b"\xee")  # cosmic ray
+    s2 = make_store(tmp_path)
+    with pytest.raises(StoreError, match="csum mismatch"):
+        s2.read("c", b"a")
+    s2.umount()
+
+
+def test_split_merge_and_alloc_survive_remount(tmp_path):
+    from ceph_tpu.placement.osdmap import ceph_str_hash_rjenkins
+
+    s = make_store(tmp_path)
+    t = tx.Transaction().create_collection("1.0")
+    oids = [b"obj%d" % i for i in range(16)]
+    for oid in oids:
+        t.write("1.0", oid, 0, oid * 600)  # >1 block each
+    s.apply_transaction(t)
+    t2 = tx.Transaction().create_collection("1.1")
+    t2.split_collection("1.0", bits=1, rem=1, dest="1.1")
+    s.apply_transaction(t2)
+    used = s.alloc.used
+    s.umount()
+    s2 = make_store(tmp_path)
+    assert s2.alloc.used == used  # allocator rebuilt from block maps
+    left, right = set(s2.list_objects("1.0")), set(s2.list_objects("1.1"))
+    assert left | right == set(oids) and not (left & right)
+    assert all(ceph_str_hash_rjenkins(o) & 1 == 1 for o in right)
+    for oid in right:
+        assert s2.read("1.1", oid) == oid * 600
+    s2.apply_transaction(
+        tx.Transaction().merge_collection("1.1", dest="1.0"))
+    assert set(s2.list_objects("1.0")) == set(oids)
+    s2.umount()
+
+
+def test_enospc(tmp_path):
+    s = make_store(tmp_path, size=64 * BLOCK)
+    t = tx.Transaction().create_collection("c")
+    s.apply_transaction(t)
+    with pytest.raises(StoreError, match="ENOSPC"):
+        s.apply_transaction(
+            tx.Transaction().write("c", b"big", 0, b"x" * (100 * BLOCK)))
+    # store still healthy after the failed txn
+    s.apply_transaction(tx.Transaction().write("c", b"ok", 0, b"fits"))
+    assert s.read("c", b"ok") == b"fits"
+    s.umount()
+
+
+def test_sigkill_child_preserves_acked_writes(tmp_path):
+    """Real kill -9: a child process writes with fsync=True and reports
+    each commit; every acked transaction must be readable after the
+    parent reopens the store (the BlueStore durability contract)."""
+    script = textwrap.dedent("""
+        import sys, os
+        sys.path.insert(0, %r)
+        from ceph_tpu.store import transaction as tx
+        from ceph_tpu.store.bluestore import BlueStoreLite
+        s = BlueStoreLite(%r, size=32 << 20, fsync=True)
+        s.mount()
+        s.apply_transaction(tx.Transaction().create_collection("c"))
+        i = 0
+        while True:
+            t = tx.Transaction().write("c", b"o%%d" %% i, 0, b"v%%d" %% i * 100)
+            s.apply_transaction(t)
+            print(i, flush=True)
+            i += 1
+    """) % (os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            str(tmp_path / "bs"))
+    proc = subprocess.Popen([sys.executable, "-c", script],
+                            stdout=subprocess.PIPE)
+    acked = -1
+    for _ in range(12):  # let a dozen commits through, then SIGKILL
+        acked = int(proc.stdout.readline())
+    proc.send_signal(signal.SIGKILL)
+    proc.wait()
+    s = BlueStoreLite(str(tmp_path / "bs"), size=32 << 20)
+    s.mount()
+    for i in range(acked + 1):
+        assert s.read("c", b"o%d" % i) == b"v%d" % i * 100
+    s.umount()
+
+
+def test_aborted_txn_after_split_does_not_corrupt(tmp_path):
+    """Regression: an aborted transaction that wrote to an object MOVED
+    by split_collection in the same transaction must not mutate the
+    committed onode (the moved Onode is the committed object — the COW
+    check must not be fooled by the cid change)."""
+    from ceph_tpu.placement.osdmap import ceph_str_hash_rjenkins
+
+    s = make_store(tmp_path)
+    t = tx.Transaction().create_collection("1.0")
+    oids = [b"o%d" % i for i in range(8)]
+    for oid in oids:
+        t.write("1.0", oid, 0, oid * 400)
+    s.apply_transaction(t)
+    moved = next(o for o in oids if ceph_str_hash_rjenkins(o) & 1 == 1)
+    bad = tx.Transaction().create_collection("1.1")
+    bad.split_collection("1.0", bits=1, rem=1, dest="1.1")
+    bad.write("1.1", moved, 0, b"X" * 5000)
+    bad.remove("1.1", b"ghost")  # aborts the whole txn
+    with pytest.raises(NotFound):
+        s.queue_transaction(bad)
+    for oid in oids:  # committed state fully intact, csums verify
+        assert s.read("1.0", oid) == oid * 400
+    assert "1.1" not in s.list_collections()
+    s.umount()
+
+
+def test_rmcoll_then_mkcoll_same_txn(tmp_path):
+    s = make_store(tmp_path)
+    t = tx.Transaction().create_collection("c")
+    t.write("c", b"a", 0, b"old")
+    s.apply_transaction(t)
+    t2 = tx.Transaction()
+    t2.remove("c", b"a")
+    t2.remove_collection("c")
+    t2.create_collection("c")
+    t2.write("c", b"b", 0, b"new")
+    s.apply_transaction(t2)
+    assert s.list_objects("c") == [b"b"]
+    s.umount()
+    s2 = make_store(tmp_path)
+    assert s2.list_objects("c") == [b"b"]
+    assert s2.read("c", b"b") == b"new"
+    s2.umount()
+
+
+def test_clone_is_independent(tmp_path):
+    s = make_store(tmp_path)
+    t = tx.Transaction().create_collection("c")
+    t.write("c", b"src", 0, b"A" * (2 * BLOCK))
+    t.clone("c", b"src", b"dup")
+    s.apply_transaction(t)
+    s.apply_transaction(tx.Transaction().write("c", b"src", 0, b"B" * 10))
+    assert s.read("c", b"dup") == b"A" * (2 * BLOCK)  # unaffected
+    assert s.read("c", b"src", 0, 10) == b"B" * 10
+    s.umount()
+
+
+def test_cluster_on_bluestore(tmp_path):
+    """vstart --bluestore role: a full EC cluster runs on BlueStoreLite,
+    survives an OSD kill + revive (remounting the same store), and a
+    whole-cluster restart from the same data dirs."""
+    import asyncio
+
+    from ceph_tpu.cluster import TestCluster
+    from ceph_tpu.placement.osdmap import Pool
+
+    data = os.urandom(100_000)
+
+    async def phase1():
+        c = TestCluster(n_osds=5, objectstore="bluestore",
+                        data_dir=str(tmp_path), size=32 << 20)
+        await c.start()
+        await c.client.create_pool(
+            Pool(id=2, name="ec", size=5, min_size=3, pg_num=8,
+                 crush_rule=1, type="erasure",
+                 ec_profile={"plugin": "rs_tpu", "k": "3", "m": "2",
+                             "backend": "device"}))
+        await c.wait_active(20)
+        await c.client.write_full(2, b"obj", data)
+        assert await c.client.read(2, b"obj") == data
+        await c.kill_osd(1)
+        await c.wait_down(1)
+        assert await c.client.read(2, b"obj") == data  # degraded
+        await c.revive_osd(1)
+        await c.wait_active(20)
+        await c.stop()
+
+    async def phase2():  # cold restart from the on-disk stores
+        c = TestCluster(n_osds=5, objectstore="bluestore",
+                        data_dir=str(tmp_path), size=32 << 20)
+        await c.start()
+        await c.client.create_pool(
+            Pool(id=2, name="ec", size=5, min_size=3, pg_num=8,
+                 crush_rule=1, type="erasure",
+                 ec_profile={"plugin": "rs_tpu", "k": "3", "m": "2",
+                             "backend": "device"}))
+        await c.wait_active(20)
+        assert await c.client.read(2, b"obj") == data
+        await c.stop()
+
+    asyncio.run(asyncio.wait_for(phase1(), 60))
+    asyncio.run(asyncio.wait_for(phase2(), 60))
+
+
+def test_kv_auto_compact(tmp_path):
+    s = make_store(tmp_path, kv_compact_bytes=4096)
+    t = tx.Transaction().create_collection("c")
+    s.apply_transaction(t)
+    for i in range(50):
+        s.apply_transaction(
+            tx.Transaction().write("c", b"o%d" % i, 0, b"x" * 200))
+    assert s.kv.wal_size() < 4096  # compaction kicked in
+    s.umount()
+    s2 = make_store(tmp_path)
+    for i in range(50):
+        assert s2.read("c", b"o%d" % i) == b"x" * 200
+    s2.umount()

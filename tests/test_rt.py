@@ -1,0 +1,181 @@
+"""Native runtime core (rt_native.cc): embedded KV (src/kv role),
+block device (src/blk role), bitmap allocator (BlueStore allocator
+role). Durability is exercised the store_test way: reopen-without-close
+and torn/corrupt WAL tails."""
+import os
+
+import pytest
+
+from ceph_tpu.native import rt
+
+
+# ------------------------------------------------------------------- kv
+
+def test_kv_basics(tmp_path):
+    kv = rt.NativeKV(tmp_path / "kv")
+    kv.put(b"a", b"1")
+    kv.put(b"b", b"2")
+    kv.put(b"c\x00x", b"3")  # embedded NUL is legal
+    assert kv.get(b"a") == b"1"
+    assert kv.get(b"zz") is None
+    kv.delete(b"a")
+    assert kv.get(b"a") is None
+    kv.batch([("put", b"d", b"4"), ("put", b"e", b"5"), ("del", b"b", None)])
+    assert kv.get(b"b") is None and kv.get(b"e") == b"5"
+    assert [k for k, _ in kv.scan()] == [b"c\x00x", b"d", b"e"]
+    assert kv.scan(b"d", b"e") == [(b"d", b"4")]
+    assert kv.scan_prefix(b"c") == [(b"c\x00x", b"3")]
+    assert kv.count() == 3
+    kv.close()
+
+
+def test_kv_reopen_replays_wal(tmp_path):
+    kv = rt.NativeKV(tmp_path / "kv")
+    kv.put(b"k", b"v")
+    kv.compact()
+    assert kv.wal_size() == 0
+    kv.put(b"post", b"snap")  # lives only in the WAL
+    kv.close()
+    kv = rt.NativeKV(tmp_path / "kv")  # snapshot + WAL replay
+    assert kv.get(b"k") == b"v" and kv.get(b"post") == b"snap"
+    kv.close()
+
+
+def test_kv_torn_tail_discarded_then_appendable(tmp_path):
+    kv = rt.NativeKV(tmp_path / "kv")
+    kv.put(b"good", b"1")
+    kv.close()
+    with open(tmp_path / "kv" / "kv.wal", "ab") as f:
+        f.write(b"\x40\x00\x00\x00GARB")  # torn record header + garbage
+    kv = rt.NativeKV(tmp_path / "kv")
+    assert kv.count() == 1
+    kv.put(b"after", b"2")  # must land where the garbage was truncated
+    kv.close()
+    kv = rt.NativeKV(tmp_path / "kv")
+    assert kv.get(b"after") == b"2" and kv.get(b"good") == b"1"
+    kv.close()
+
+
+def test_kv_corrupt_record_crc(tmp_path):
+    kv = rt.NativeKV(tmp_path / "kv")
+    kv.put(b"a", b"1")
+    kv.put(b"b", b"2")
+    kv.close()
+    wal = tmp_path / "kv" / "kv.wal"
+    blob = bytearray(wal.read_bytes())
+    blob[-1] ^= 0xFF  # flip a bit in the last record's body
+    wal.write_bytes(bytes(blob))
+    kv = rt.NativeKV(tmp_path / "kv")
+    assert kv.get(b"a") == b"1"
+    assert kv.get(b"b") is None  # corrupt tail record dropped
+    kv.close()
+
+
+def test_kv_corrupt_snapshot_rejected(tmp_path):
+    kv = rt.NativeKV(tmp_path / "kv")
+    kv.put(b"key", b"value" * 100)
+    kv.compact()
+    kv.close()
+    sst = tmp_path / "kv" / "kv.sst"
+    blob = bytearray(sst.read_bytes())
+    blob[30] ^= 0x01
+    sst.write_bytes(bytes(blob))
+    with pytest.raises(rt.KvError):
+        rt.NativeKV(tmp_path / "kv")
+
+
+def test_kv_batch_atomic_on_malformed(tmp_path):
+    kv = rt.NativeKV(tmp_path / "kv")
+    kv.put(b"x", b"1")
+    with pytest.raises(ValueError):
+        kv.batch([("put", b"y", b"2"), ("nope", b"z", b"3")])
+    assert kv.get(b"y") is None  # nothing half-applied
+    kv.close()
+
+
+def test_kv_prefix_end_edge_cases():
+    from ceph_tpu.native.rt import _prefix_end
+
+    assert _prefix_end(b"abc") == b"abd"
+    assert _prefix_end(b"a\xff") == b"b"
+    assert _prefix_end(b"\xff\xff") == b""  # scan to the end
+
+
+# ------------------------------------------------------------------ blk
+
+def test_blk_sync_and_async(tmp_path):
+    dev = rt.BlockDevice(tmp_path / "block", 1 << 20, n_threads=3)
+    assert dev.size == 1 << 20
+    dev.submit_write(0, b"hello")
+    dev.submit_write(4096, b"world" * 100)
+    dev.flush()
+    assert dev.pread(0, 5) == b"hello"
+    assert dev.pread(4096, 500) == b"world" * 100
+    assert dev.pread(1 << 19, 16) == b"\x00" * 16  # sparse reads zeros
+    dev.pwrite(8192, b"sync")
+    assert dev.pread(8192, 4) == b"sync"
+    dev.close()
+
+
+def test_blk_many_concurrent_writes(tmp_path):
+    dev = rt.BlockDevice(tmp_path / "block", 4 << 20, n_threads=4)
+    for i in range(256):
+        dev.submit_write(i * 4096, bytes([i % 256]) * 4096)
+    dev.drain()
+    for i in range(0, 256, 37):
+        assert dev.pread(i * 4096, 4096) == bytes([i % 256]) * 4096
+    dev.close()
+
+
+def test_blk_sparse_file_is_cheap(tmp_path):
+    dev = rt.BlockDevice(tmp_path / "block", 1 << 32, n_threads=1)  # 4 GiB
+    dev.pwrite(0, b"x")
+    dev.close()
+    # apparent size is 4 GiB, real usage a few blocks
+    assert os.stat(tmp_path / "block").st_size == 1 << 32
+    assert os.stat(tmp_path / "block").st_blocks * 512 < 1 << 20
+
+
+# ------------------------------------------------------------ allocator
+
+def test_alloc_contiguous_and_release():
+    al = rt.BitmapAllocator(256)
+    a, b, c = al.alloc(10), al.alloc(100), al.alloc(64)
+    assert al.used == 174
+    assert len({a, b, c}) == 3
+    al.release(b, 100)
+    assert al.used == 74
+    al.alloc(100)  # must fit back into the released hole
+    assert al.used == 174
+    al.mark_used(200, 10)
+    al.mark_used(205, 10)  # overlapping mark is idempotent
+    assert al.used == 174 + 15
+    with pytest.raises(MemoryError):
+        al.alloc(300)
+    al.close()
+
+
+def test_alloc_word_boundaries():
+    al = rt.BitmapAllocator(192)  # 3 words
+    runs = [al.alloc(63), al.alloc(65), al.alloc(64)]
+    assert al.used == 192
+    spans = sorted((s, n) for s, n in zip(runs, (63, 65, 64)))
+    end = 0
+    for s, n in spans:  # perfectly packed, no overlap
+        assert s == end
+        end = s + n
+    with pytest.raises(MemoryError):
+        al.alloc(1)
+    al.release(64, 64)
+    got = al.alloc(64)
+    assert got == 64
+    al.close()
+
+
+def test_alloc_wraps_cursor():
+    al = rt.BitmapAllocator(128)
+    first = al.alloc(100)
+    al.release(first, 100)  # cursor is past the hole; scan must wrap
+    again = al.alloc(120)
+    assert again == 0
+    al.close()
