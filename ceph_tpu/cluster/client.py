@@ -167,12 +167,10 @@ class RadosClient:
                 pass
             await asyncio.sleep(0.05)
 
-    async def _submit(self, pool_id: int, name: str | bytes,
-                      ops: list[tuple]) -> M.MOSDOpReply:
-        if self.osdmap is None or pool_id not in self.osdmap.pools:
-            await self._wait_pool(pool_id)
-        oid = name.encode() if isinstance(name, str) else bytes(name)
-        pgid = self.osdmap.object_to_pg(pool_id, oid)
+    async def _submit_pg(self, pgid, oid: bytes,
+                         ops: list[tuple]) -> M.MOSDOpReply:
+        """Track + send one op vector to a PG's primary and await the
+        reply (shared by object ops and PG-level ops like pgls)."""
         self._tid += 1
         msg = M.MOSDOp(tid=self._tid, pgid=pgid, oid=oid, ops=ops,
                        epoch=self.osdmap.epoch)
@@ -182,7 +180,15 @@ class RadosClient:
         op.target = self._calc_target(pgid)
         if op.target >= 0:
             await self._send_op(op)
-        reply = await asyncio.wait_for(op.fut, self.op_timeout)
+        return await asyncio.wait_for(op.fut, self.op_timeout)
+
+    async def _submit(self, pool_id: int, name: str | bytes,
+                      ops: list[tuple]) -> M.MOSDOpReply:
+        if self.osdmap is None or pool_id not in self.osdmap.pools:
+            await self._wait_pool(pool_id)
+        oid = name.encode() if isinstance(name, str) else bytes(name)
+        pgid = self.osdmap.object_to_pg(pool_id, oid)
+        reply = await self._submit_pg(pgid, oid, ops)
         if reply.result != M.OK:
             if reply.result == M.ENOENT:
                 raise KeyError(name)
@@ -195,6 +201,26 @@ class RadosClient:
         (IoCtxImpl::operate role); returns each op's output bytes."""
         reply = await self._submit(pool_id, name, op.ops)
         return [d for _r, d in reply.outs]
+
+    async def list_objects(self, pool_id: int) -> list[bytes]:
+        """All object names in the pool via a concurrent PGLS sweep of
+        every PG (the rados ls / librados NObjectIterator role)."""
+        if self.osdmap is None or pool_id not in self.osdmap.pools:
+            await self._wait_pool(pool_id)
+        from ..utils import denc
+
+        pool = self.osdmap.pools[pool_id]
+        replies = await asyncio.gather(*(
+            self._submit_pg((pool_id, ps), b"", [M.osd_op("pgls")])
+            for ps in range(pool.pg_num)))
+        names: list[bytes] = []
+        for ps, reply in enumerate(replies):
+            if reply.result != M.OK:
+                raise IOError(f"pgls {(pool_id, ps)} failed: "
+                              f"{reply.result}")
+            oids, _ = denc.dec_list(reply.outs[0][1], 0, denc.dec_bytes)
+            names.extend(oids)
+        return sorted(names)
 
     # ------------------------------------------------------------ surface
 

@@ -233,6 +233,23 @@ class PG:
             return
         perf = self.osd.perf
         perf.inc("op")
+        if len(m.ops) == 1 and m.ops[0][0] == "pgls":
+            # PG-level object listing (the CEPH_OSD_OP_PGLS role): not
+            # an object op — answer from the collection directly
+            perf.inc("op_r")
+            try:
+                objs = self.osd.store.list_objects(self.cid)
+            except NotFound:  # no write ever landed: empty PG
+                objs = []
+            oids = sorted(o for o in objs if o != META_OID)
+            out = denc.enc_list(oids, denc.enc_bytes)
+            await self.osd.send(
+                src,
+                M.MOSDOpReply(tid=m.tid, result=M.OK, data=out, size=0,
+                              outs=[(0, out)],
+                              epoch=self.osd.osdmap.epoch),
+            )
+            return
         # cls calls may mutate: treat them as write-class for locking
         write_class = any(o[0] in WRITE_OPS or o[0] == "call"
                           for o in m.ops)
