@@ -14,6 +14,7 @@ from dataclasses import dataclass, field
 
 from ..placement import encoding as menc
 from ..placement.osdmap import Pool
+from ..utils import trace
 from . import messages as M
 
 
@@ -38,6 +39,7 @@ class RadosClient:
         self._map_waiters: list[asyncio.Future] = []
         self._watches: dict[tuple[bytes, int], object] = {}
         self._next_cookie = 0
+        self._tracer = trace.get_tracer(name)
 
     # ---------------------------------------------------------- lifecycle
 
@@ -172,15 +174,22 @@ class RadosClient:
         """Track + send one op vector to a PG's primary and await the
         reply (shared by object ops and PG-level ops like pgls)."""
         self._tid += 1
-        msg = M.MOSDOp(tid=self._tid, pgid=pgid, oid=oid, ops=ops,
-                       epoch=self.osdmap.epoch)
-        op = _InFlight(msg=msg, fut=asyncio.get_running_loop()
-                       .create_future())
-        self._ops[self._tid] = op
-        op.target = self._calc_target(pgid)
-        if op.target >= 0:
-            await self._send_op(op)
-        return await asyncio.wait_for(op.fut, self.op_timeout)
+        verb = ops[0][0] if ops else "noop"
+        with self._tracer.start_span(verb) as span:
+            span.tag("pgid", pgid).tag("oid",
+                                       oid[:64].decode(errors="replace"))
+            msg = M.MOSDOp(tid=self._tid, pgid=pgid, oid=oid, ops=ops,
+                           epoch=self.osdmap.epoch, trace=span.ctx)
+            op = _InFlight(msg=msg, fut=asyncio.get_running_loop()
+                           .create_future())
+            self._ops[self._tid] = op
+            op.target = self._calc_target(pgid)
+            span.tag("target", op.target)
+            if op.target >= 0:
+                await self._send_op(op)
+            reply = await asyncio.wait_for(op.fut, self.op_timeout)
+            span.tag("result", reply.result)
+        return reply
 
     async def _submit(self, pool_id: int, name: str | bytes,
                       ops: list[tuple]) -> M.MOSDOpReply:

@@ -155,7 +155,9 @@ class MOSDOp(Message):
         ("oid", "bytes"),
         ("ops", (_enc_osd_ops, _dec_osd_ops)),
         ("epoch", "u32"),  # client's map epoch at send time
+        ("trace", "pair:u64:u64"),  # span ctx (utils/trace; 0,0 = off)
     )
+    DEFAULTS = {"trace": (0, 0)}
 
 
 @register_message
@@ -185,7 +187,9 @@ class MOSDRepOp(Message):
         ("txn", "bytes"),  # encoded store Transaction
         ("entry", "bytes"),  # encoded PGLog entry
         ("epoch", "u32"),
+        ("trace", "pair:u64:u64"),  # span ctx (utils/trace; 0,0 = off)
     )
+    DEFAULTS = {"trace": (0, 0)}
 
 
 @register_message
@@ -205,7 +209,9 @@ class MECSubWrite(Message):
         ("txn", "bytes"),
         ("entry", "bytes"),
         ("epoch", "u32"),
+        ("trace", "pair:u64:u64"),  # span ctx (utils/trace; 0,0 = off)
     )
+    DEFAULTS = {"trace": (0, 0)}
 
 
 @register_message
@@ -225,7 +231,9 @@ class MECSubRead(Message):
         ("oid", "bytes"),
         ("offset", "u64"),
         ("length", "i64"),
+        ("trace", "pair:u64:u64"),  # span ctx (utils/trace; 0,0 = off)
     )
+    DEFAULTS = {"trace": (0, 0)}
 
 
 @register_message

@@ -25,6 +25,7 @@ from ..placement import encoding as menc
 from ..store.memstore import MemStore
 from ..utils import config as cfg
 from ..utils.admin import AdminSocket
+from ..utils import trace
 from ..utils.fault import FaultInjector
 from ..utils.perf import PerfCounters
 from . import messages as M
@@ -135,6 +136,7 @@ class OSDLite:
         self.op_scheduler = MClockScheduler()
         self.throttle = Throttle(self.conf["osd_client_message_size_cap"])
         self.optracker = OpTracker()
+        self.tracer = trace.get_tracer(self.name)
         self.pending: dict = {}  # key -> Future (sub-op replies)
         self._subtid = 0
         self._codecs: dict[int, object] = {}
@@ -303,6 +305,15 @@ class OSDLite:
             ),
             "recently completed ops with event timelines",
         )
+        sock.register(
+            "dump_tracing",
+            lambda a: self.tracer.dump(
+                trace_id=(int(a["trace_id"], 16)
+                          if "trace_id" in a else None),
+                limit=int(a.get("limit", 200)),
+            ),
+            "finished spans, zipkin JSON shape: {trace_id?, limit?}",
+        )
         await sock.start()
         self.admin = sock
 
@@ -394,17 +405,20 @@ class OSDLite:
             )
         elif isinstance(msg, M.MOSDRepOp):
             pg = self._ensure_pg(msg.pgid, -1)
-            await pg.handle_rep_op(src, msg)
+            with self.tracer.start_span("sub_write", parent=msg.trace):
+                await pg.handle_rep_op(src, msg)
         elif isinstance(msg, M.MOSDRepOpReply):
             self._resolve(msg.tid, msg)
         elif isinstance(msg, M.MECSubWrite):
             pg = self._ensure_pg(msg.pgid, msg.shard)
-            await pg.handle_ec_write(src, msg)
+            with self.tracer.start_span("ec_sub_write", parent=msg.trace):
+                await pg.handle_ec_write(src, msg)
         elif isinstance(msg, M.MECSubWriteReply):
             self._resolve(msg.tid, msg)
         elif isinstance(msg, M.MECSubRead):
             pg = self._ensure_pg(msg.pgid, msg.shard)
-            await pg.handle_ec_read(src, msg)
+            with self.tracer.start_span("ec_sub_read", parent=msg.trace):
+                await pg.handle_ec_read(src, msg)
         elif isinstance(msg, M.MECSubReadReply):
             self._resolve(msg.tid, msg)
         elif isinstance(msg, M.MPGInfoReq):

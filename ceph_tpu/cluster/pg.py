@@ -35,8 +35,15 @@ from .. import native
 from ..store import NotFound
 from ..store import transaction as tx
 from ..utils import denc
+from ..utils import trace as tr
 from . import messages as M
 from .pglog import OP_DELETE, OP_MODIFY, ZERO, Entry, PGInfo, PGLog
+
+
+def _trace_ctx() -> tuple[int, int]:
+    """Ambient span ctx for outgoing sub-ops (pg_trace threading,
+    ECBackend.cc:831-858 role)."""
+    return tr.current.get()
 
 if TYPE_CHECKING:
     from .osd import OSDLite
@@ -233,6 +240,19 @@ class PG:
             return
         perf = self.osd.perf
         perf.inc("op")
+        verb = m.ops[0][0] if m.ops else "noop"
+        span = self.osd.tracer.start_span(
+            f"pg.do_op {verb}", parent=m.trace
+        ).tag("pgid", self.pgid).tag("oid",
+                                     m.oid[:64].decode(errors="replace"))
+        ctx_token = tr.current.set(span.ctx)
+        try:
+            await self._do_op_traced(src, m, perf)
+        finally:
+            tr.current.reset(ctx_token)
+            span.finish()
+
+    async def _do_op_traced(self, src: str, m: M.MOSDOp, perf) -> None:
         if len(m.ops) == 1 and m.ops[0][0] == "pgls":
             # PG-level object listing (the CEPH_OSD_OP_PGLS role): not
             # an object op — answer from the collection directly
@@ -545,7 +565,8 @@ class PG:
                 f"osd.{o}",
                 M.MOSDRepOp(tid=subtid, pgid=self.pgid, txn=rt.encode(),
                             entry=entry.encode(),
-                            epoch=self.osd.osdmap.epoch),
+                            epoch=self.osd.osdmap.epoch,
+                            trace=_trace_ctx()),
             )
         await self.osd.gather(waits)
 
@@ -594,7 +615,8 @@ class PG:
                 f"osd.{target}",
                 M.MECSubWrite(tid=subtid, pgid=self.pgid, shard=j,
                               txn=rt.encode(), entry=entry.encode(),
-                              epoch=self.osd.osdmap.epoch),
+                              epoch=self.osd.osdmap.epoch,
+                              trace=_trace_ctx()),
             )
         await self.osd.gather(waits)
 
@@ -664,7 +686,8 @@ class PG:
                 await self.osd.send(
                     f"osd.{target}",
                     M.MECSubRead(tid=subtid, pgid=self.pgid, shard=j,
-                                 oid=oid, offset=0, length=-1),
+                                 oid=oid, offset=0, length=-1,
+                                 trace=_trace_ctx()),
                 )
             for j, target, subtid, fut in waits:
                 reply = await self.osd.await_reply(subtid, fut, target)
@@ -1013,7 +1036,8 @@ class PG:
                 await self.osd.send(
                     f"osd.{target}",
                     M.MECSubRead(tid=subtid, pgid=self.pgid, shard=j,
-                                 oid=oid, offset=0, length=-1),
+                                 oid=oid, offset=0, length=-1,
+                                 trace=_trace_ctx()),
                 )
                 reply = await self.osd.await_reply(subtid, fut, target)
                 if reply.result == M.OK:

@@ -68,6 +68,10 @@ class Message:
     TYPE: int = 0
     FIELDS: tuple = ()
 
+    #: optional per-field defaults (e.g. trace contexts) — lets a field
+    #: be added to a message without touching every constructor site
+    DEFAULTS: dict = {}
+
     def __init__(self, **kw):
         names = [n for n, _ in self.FIELDS]
         unknown = set(kw) - set(names)
@@ -75,6 +79,9 @@ class Message:
             raise TypeError(f"{type(self).__name__}: unknown fields {unknown}")
         for n, _ in self.FIELDS:
             if n not in kw:
+                if n in self.DEFAULTS:
+                    setattr(self, n, self.DEFAULTS[n])
+                    continue
                 raise TypeError(f"{type(self).__name__}: missing field {n!r}")
             setattr(self, n, kw[n])
 

@@ -1,0 +1,117 @@
+"""Distributed tracing (utils/trace): span mechanics, cross-daemon
+context propagation through real cluster ops (the blkin pg_trace arc:
+client -> primary PG -> EC sub-ops), admin-socket dump, and the
+standalone exporter's admin-socket scrape."""
+import asyncio
+import importlib.util
+import os
+
+from ceph_tpu.cluster import TestCluster
+from ceph_tpu.placement.osdmap import Pool
+from ceph_tpu.utils import trace
+
+
+def run(coro):
+    asyncio.run(asyncio.wait_for(coro, 120))
+
+
+def test_span_basics():
+    t = trace.get_tracer("svc-a")
+    with t.start_span("root") as root:
+        root.tag("k", "v")
+        child = t.start_span("child", parent=root)
+        child.finish()
+    assert child.trace_id == root.trace_id
+    assert child.parent_id == root.span_id
+    assert root.parent_id == 0
+    dumped = t.dump(trace_id=root.trace_id)
+    names = {d["name"] for d in dumped}
+    assert names == {"root", "child"}
+    by_name = {d["name"]: d for d in dumped}
+    assert by_name["child"]["parentId"] == f"{root.span_id:016x}"
+    assert by_name["root"]["tags"] == {"k": "v"}
+
+
+def test_wire_ctx_round_trip():
+    t = trace.get_tracer("svc-b")
+    parent = t.start_span("parent")
+    # NO_CTX parent starts a fresh trace
+    fresh = t.start_span("fresh", parent=trace.NO_CTX)
+    assert fresh.parent_id == 0 and fresh.trace_id != parent.trace_id
+    # a wire ctx tuple parents correctly
+    remote = t.start_span("remote", parent=parent.ctx)
+    assert remote.trace_id == parent.trace_id
+    assert remote.parent_id == parent.span_id
+    parent.finish(), fresh.finish(), remote.finish()
+
+
+def test_trace_propagates_through_ec_write():
+    """One client write to an EC pool must produce client, pg.do_op and
+    ec_sub_write spans sharing one trace id, parented as a tree."""
+    async def t():
+        c = TestCluster(n_osds=5)
+        await c.start()
+        await c.client.create_pool(
+            Pool(id=2, name="ec", size=5, min_size=3, pg_num=4,
+                 crush_rule=1, type="erasure",
+                 ec_profile={"plugin": "rs_tpu", "k": "3", "m": "2"}))
+        await c.wait_active(20)
+        await c.client.write_full(2, b"traced-obj", b"z" * 20000)
+        got = await c.client.read(2, b"traced-obj")
+        assert got == b"z" * 20000
+        await c.stop()
+
+    run(t())
+    client_spans = [s for s in trace.get_tracer("client.0").dump()
+                    if s["name"] == "writefull"
+                    and s["tags"].get("oid") == "traced-obj"]
+    assert client_spans, "client span missing"
+    root = client_spans[-1]
+    spans = trace.dump_all()
+    tree = [s for s in spans if s["traceId"] == root["traceId"]]
+    names = {s["name"] for s in tree}
+    assert "pg.do_op writefull" in names
+    assert "ec_sub_write" in names
+    # parenting: do_op under the client span, sub-writes under do_op
+    do_op = next(s for s in tree if s["name"] == "pg.do_op writefull")
+    assert do_op["parentId"] == root["id"]
+    subs = [s for s in tree if s["name"] == "ec_sub_write"]
+    assert subs and all(s["parentId"] == do_op["id"] for s in subs)
+    # spans come from more than one daemon (distributed, not local)
+    services = {s["localEndpoint"]["serviceName"] for s in tree}
+    assert len(services) >= 3
+
+
+def test_admin_socket_dump_tracing_and_exporter(tmp_path):
+    async def t():
+        c = TestCluster(n_osds=3)
+        await c.start()
+        await c.client.create_pool(
+            Pool(id=1, name="rep", size=3, pg_num=4, crush_rule=0))
+        await c.wait_active(20)
+        await c.client.write_full(1, b"obj", b"x" * 500)
+        sock_dir = str(tmp_path / "asok")
+        os.makedirs(sock_dir)
+        for i, osd in enumerate(c.osds):
+            await osd.start_admin(os.path.join(sock_dir, f"osd.{i}.sock"))
+        from ceph_tpu.utils.admin import admin_command
+
+        dumps = []
+        for i in range(3):
+            dumps.extend(await admin_command(
+                os.path.join(sock_dir, f"osd.{i}.sock"), "dump_tracing"))
+        assert any(s["name"].startswith("pg.do_op") for s in dumps)
+
+        # the standalone exporter scrapes the same sockets
+        spec = importlib.util.spec_from_file_location(
+            "exporter", os.path.join(
+                os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                "tools", "exporter.py"))
+        exporter = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(exporter)
+        text = await exporter.scrape(sock_dir)
+        assert 'ceph_tpu_daemon_up{ceph_daemon="osd.1"} 1' in text
+        assert "ceph_tpu_op" in text  # op counters made it through
+        await c.stop()
+
+    run(t())
