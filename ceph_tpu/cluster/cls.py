@@ -287,3 +287,27 @@ def version_check_eq(ctx: ClsContext, inp: bytes) -> bytes:
     if cur != want:
         raise ClsError(_ECANCELED, f"version {cur} != {want}")
     return b""
+
+
+# ================================================== built-in: journal
+
+
+@register("journal", "trim", RD | WR)
+def journal_trim(ctx: ClsContext, inp: bytes) -> bytes:
+    """Atomically drop journal history before a LOGICAL offset: rewrite
+    the record stream and advance the `journal.base` xattr in one op
+    (the Journaler trim role). Server-side because a client-side
+    read-modify-writefull would race concurrent appends and destroy
+    records landed between the read and the write."""
+    upto, _ = denc.dec_u64(inp, 0)
+    raw = ctx.getxattr("journal.base")
+    base = denc.dec_u64(raw, 0)[0] if raw else 0
+    cut = upto - base
+    if cut <= 0:
+        return b""
+    data = ctx.read()
+    if cut > len(data):
+        raise ClsError(_EINVAL, f"trim {upto} past tail {base + len(data)}")
+    ctx.write_full(data[cut:])
+    ctx.setxattr("journal.base", denc.enc_u64(upto))
+    return b""

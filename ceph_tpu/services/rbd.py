@@ -81,6 +81,14 @@ class RBD:
         await img.refresh()
         return img
 
+    async def list(self) -> list[str]:
+        """Image names in the pool (rbd ls role) via the PGLS sweep."""
+        prefix = b"rbd_header."
+        return sorted(
+            oid[len(prefix):].decode()
+            for oid in await self.client.list_objects(self.pool_id)
+            if oid.startswith(prefix))
+
     async def remove(self, name: str) -> None:
         img = await self.open(name)
         if img.snaps:
