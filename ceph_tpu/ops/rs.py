@@ -126,7 +126,24 @@ def gf_matmul_u32_mxu(matrix: np.ndarray, chunks: jax.Array) -> jax.Array:
         raise ValueError(
             f"chunks axis -2 is {chunks.shape[-2]}, matrix wants {cols}"
         )
-    bm = jnp.asarray(_lift_bitmatrix(matrix))
+    return gf_matmul_bm(jnp.asarray(_lift_bitmatrix(matrix)), chunks)
+
+
+def gf_matmul_bm(bm: jax.Array, chunks: jax.Array) -> jax.Array:
+    """einsum GF matmul over a DEVICE-RESIDENT (R*8, C*8) bit-matrix
+    (standard _lift_bitmatrix row order). Unlike the host-constant
+    paths, bm may be a traced value — e.g. a per-device block selected
+    with lax.axis_index inside shard_map (parallel/shard_comm)."""
+    if bm.shape[0] % 8 or bm.shape[1] % 8:
+        raise ValueError(
+            f"bm shape {bm.shape} is not a lifted bit-matrix (pass the "
+            "(R*8, C*8) _lift_bitmatrix form, not the raw GF matrix)")
+    rows = bm.shape[0] // 8
+    cols = bm.shape[1] // 8
+    if chunks.shape[-2] * 8 != bm.shape[1]:
+        raise ValueError(
+            f"chunks axis -2 is {chunks.shape[-2]}, bit-matrix wants "
+            f"{bm.shape[1] // 8}")
     x = chunks.astype(jnp.uint32)
     lead = x.shape[:-2]
     w = x.shape[-1]
