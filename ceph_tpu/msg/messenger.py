@@ -87,20 +87,34 @@ class TcpMessenger:
     With ``keys`` set (a KeyServer holding this entity's secret and the
     peers'), connections run the cephx-role handshake (msg/auth.py) and
     every subsequent frame carries an HMAC tag (msgr2 signed mode);
-    unauthenticated peers and tampered frames are rejected.
+    with ``secure=True`` frames are instead AES-GCM encrypted under the
+    session key with counter nonces (msgr2 secure mode / crypto_onwire
+    role — an acceptor configured secure rejects plain-signed peers).
+    Unauthenticated peers and tampered/replayed frames are rejected.
+
+    ``compress_threshold`` enables on-wire compression
+    (compression_onwire role): payloads at or above the threshold are
+    zlib-deflated when that actually shrinks them, flagged per frame.
     """
 
     AUTH_HELLO = 0xFF01
     AUTH_CHALLENGE = 0xFF02
     AUTH_PROOF = 0xFF03
     AUTH_OK = 0xFF04
+    FLAG_COMPRESSED = 0x1
 
-    def __init__(self, name: str, dispatcher: Dispatcher, keys=None):
+    def __init__(self, name: str, dispatcher: Dispatcher, keys=None,
+                 secure: bool = False,
+                 compress_threshold: int | None = None):
         self.name = name
         self.dispatcher = dispatcher
         self.keys = keys  # KeyServer | None
+        self.secure = secure
+        if secure and keys is None:
+            raise ValueError("secure mode needs a KeyServer")
+        self.compress_threshold = compress_threshold
         self.addrbook: dict[str, tuple[str, int]] = {}
-        self._conns: dict[str, tuple] = {}  # dst -> (writer, auth|None)
+        self._conns: dict[str, tuple] = {}  # dst -> (writer, auth, sess)
         self._server: asyncio.AbstractServer | None = None
         self._readers: set[asyncio.Task] = set()
 
@@ -115,7 +129,7 @@ class TcpMessenger:
         # drained FIRST or close deadlocks on any open connection
         if self._server:
             self._server.close()
-        for w, _auth in self._conns.values():
+        for w, *_rest in self._conns.values():
             w.close()
         self._conns.clear()
         readers = list(self._readers)
@@ -130,10 +144,13 @@ class TcpMessenger:
         task = asyncio.current_task()
         self._readers.add(task)
         try:
-            auth = None
+            auth, sess = None, None
             if self.keys is not None:
-                auth = await self._accept_handshake(reader, writer)
-            await self._read_loop(reader, auth)
+                auth, sess = await self._accept_handshake(reader, writer)
+            if sess is not None:
+                await self._read_loop_secure(reader, sess)
+            else:
+                await self._read_loop(reader, auth)
         except (asyncio.IncompleteReadError, ConnectionError,
                 AuthError):
             pass
@@ -143,7 +160,7 @@ class TcpMessenger:
 
     async def _accept_handshake(self, reader, writer):
         """Acceptor side of the cephx-role handshake."""
-        from .auth import Authenticator, handshake_accept
+        from .auth import Authenticator, SecureSession, handshake_accept
 
         hello = await self._read_one_frame(reader)
         if hello is None or hello.type != self.AUTH_HELLO:
@@ -158,12 +175,17 @@ class TcpMessenger:
             raise AuthError("expected AUTH_PROOF")
         session = handshake_accept(self.keys, hello.payload, challenge,
                                    proof.payload)
-        entity, _nonce = Authenticator.parse_hello(hello.payload)
+        entity, _nonce, mode = Authenticator.parse_hello(hello.payload)
+        if self.secure and mode != "secure":
+            # policy: a secure acceptor refuses plain-signed peers
+            raise AuthError(f"{entity!r} did not offer secure mode")
         auth = Authenticator(entity, b"")
         auth.session_key = session
         writer.write(encode_frame(Frame(self.AUTH_OK, b"")))
         await writer.drain()
-        return auth
+        sess = (SecureSession(session, "acceptor")
+                if mode == "secure" else None)
+        return auth, sess
 
     @staticmethod
     async def _read_one_frame(reader) -> Frame | None:
@@ -205,9 +227,48 @@ class TcpMessenger:
                 auth.check(bytes(buf[:used]), bytes(buf[used:used + 16]))
                 used += 16
             buf = buf[used:]
-            sender, off = denc.dec_str(frame.payload, 0)
-            msg = decode_message(frame.type, frame.payload[off:])
-            await self.dispatcher(sender, msg)
+            await self._dispatch_frame(frame)
+
+    async def _read_loop_secure(self, reader: asyncio.StreamReader,
+                                sess) -> None:
+        """Secure mode: u32-length-prefixed AES-GCM records, each
+        holding one ordinary CRC frame."""
+        import struct
+
+        while True:
+            try:
+                hdr = await reader.readexactly(4)
+                (ln,) = struct.unpack("<I", hdr)
+                ct = await reader.readexactly(ln)
+            except asyncio.IncompleteReadError:
+                return  # clean EOF mid-record: peer went away
+            record = sess.decrypt(ct)  # AuthError on tamper/replay
+            try:
+                frame, _used = decode_frame(record)
+            except FrameError:
+                raise ConnectionError("corrupt frame inside record")
+            await self._dispatch_frame(frame)
+
+    #: inflate cap: no hostile frame may expand past this, however well
+    #: it deflates (decompression-bomb guard)
+    MAX_INFLATE = 64 << 20
+
+    async def _dispatch_frame(self, frame: Frame) -> None:
+        payload = frame.payload
+        if frame.flags & self.FLAG_COMPRESSED:
+            import zlib
+
+            try:
+                d = zlib.decompressobj()
+                payload = d.decompress(payload, self.MAX_INFLATE)
+                if d.unconsumed_tail:
+                    raise ConnectionError("compressed frame exceeds "
+                                          "inflate cap")
+            except zlib.error:
+                raise ConnectionError("corrupt compressed frame")
+        sender, off = denc.dec_str(payload, 0)
+        msg = decode_message(frame.type, payload[off:])
+        await self.dispatcher(sender, msg)
 
     async def _connect(self, dst: str):
         if dst not in self.addrbook:
@@ -217,15 +278,16 @@ class TcpMessenger:
             reader, writer = await asyncio.open_connection(host, port)
         except OSError as e:
             raise SendError(f"connect to {dst} failed: {e}") from e
-        auth = None
+        auth, sess = None, None
         if self.keys is not None:
-            from .auth import Authenticator
+            from .auth import Authenticator, SecureSession
 
             secret = self.keys.get(self.name)
             if secret is None:
                 raise SendError(f"no secret for {self.name!r}")
             auth = Authenticator(self.name, secret)
-            hello, nonce = auth.make_hello()
+            mode = "secure" if self.secure else "sign"
+            hello, nonce = auth.make_hello(mode)
             writer.write(encode_frame(Frame(self.AUTH_HELLO, hello)))
             await writer.drain()
             challenge = await self._read_one_frame(reader)
@@ -242,18 +304,29 @@ class TcpMessenger:
                 writer.close()
                 raise SendError("auth rejected")
             auth.derive_session(secret, challenge.payload, nonce)
-        return writer, auth
+            if self.secure:
+                sess = SecureSession(auth.session_key, "connector")
+        return writer, auth, sess
 
     async def send(self, dst: str, msg: Message) -> None:
         conn = self._conns.get(dst)
         if conn is None or conn[0].is_closing():
             conn = await self._connect(dst)
             self._conns[dst] = conn
-        writer, auth = conn
-        wire = encode_frame(
-            Frame(msg.TYPE, denc.enc_str(self.name) + msg.encode())
-        )
-        if auth is not None:
+        writer, auth, sess = conn
+        payload = denc.enc_str(self.name) + msg.encode()
+        flags = 0
+        if (self.compress_threshold is not None
+                and len(payload) >= self.compress_threshold):
+            import zlib
+
+            packed = zlib.compress(payload, 1)
+            if len(packed) < len(payload):
+                payload, flags = packed, self.FLAG_COMPRESSED
+        wire = encode_frame(Frame(msg.TYPE, payload, flags))
+        if sess is not None:
+            wire = sess.encrypt(wire)  # secure mode: GCM supersedes HMAC
+        elif auth is not None:
             wire += auth.sign(wire)
         try:
             writer.write(wire)
