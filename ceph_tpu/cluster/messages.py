@@ -448,3 +448,81 @@ class MScrubReply(Message):
     FIELDS = (("pgid", PGID), ("shard", "i32"), ("tid", "u64"),
               ("objects", (_enc_scrub_map, _dec_scrub_map)),
               ("errors", "list:bytes"))
+
+
+# ----------------------------------------------------- config / balancer
+
+
+def _enc_cfg_entries(v):
+    from ..utils import denc
+
+    return denc.enc_list(
+        v, lambda e: (denc.enc_str(e[0]) + denc.enc_str(e[1])
+                      + denc.enc_str(e[2])))
+
+
+def _dec_cfg_entries(buf, off):
+    from ..utils import denc
+
+    def one(b, o):
+        who, o = denc.dec_str(b, o)
+        key, o = denc.dec_str(b, o)
+        val, o = denc.dec_str(b, o)
+        return (who, key, val), o
+
+    return denc.dec_list(buf, off, one)
+
+
+@register_message
+class MConfigSet(Message):
+    """`ceph config set <who> <key> <value>` (ConfigMonitor role);
+    who is "global", a daemon class ("osd"), or an instance ("osd.3")."""
+    TYPE = 60
+    FIELDS = (("who", "str"), ("key", "str"), ("value", "str"))
+
+
+@register_message
+class MConfig(Message):
+    """Central config DB pushed to subscribers (MConfig role); daemons
+    apply the sections that match them, most specific last."""
+    TYPE = 61
+    FIELDS = (("entries", (_enc_cfg_entries, _dec_cfg_entries)),)
+
+
+def _enc_upmap_plan(v):
+    from ..utils import denc
+
+    def one(e):
+        pgid, pairs = e
+        return (denc.enc_i32(pgid[0]) + denc.enc_u32(pgid[1])
+                + denc.enc_list(
+                    pairs,
+                    lambda p: denc.enc_i32(p[0]) + denc.enc_i32(p[1])))
+
+    return denc.enc_list(v, one)
+
+
+def _dec_upmap_plan(buf, off):
+    from ..utils import denc
+
+    def pair(b, o):
+        a, o = denc.dec_i32(b, o)
+        c, o = denc.dec_i32(b, o)
+        return (a, c), o
+
+    def one(b, o):
+        pool, o = denc.dec_i32(b, o)
+        ps, o = denc.dec_u32(b, o)
+        pairs, o = denc.dec_list(b, o, pair)
+        return ((pool, ps), pairs), o
+
+    return denc.dec_list(buf, off, one)
+
+
+@register_message
+class MUpmapItems(Message):
+    """`ceph osd pg-upmap-items` (OSDMonitor role): a PLAN of per-PG
+    [(from, to)] replacement pairs, committed as ONE map epoch (an
+    empty pair list clears that PG's entry)."""
+    TYPE = 62
+    FIELDS = (("entries", (_enc_upmap_plan, _dec_upmap_plan)),)

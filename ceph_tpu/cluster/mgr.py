@@ -12,6 +12,7 @@ All surfaces are exposed on an admin socket ('ceph status' /
 """
 from __future__ import annotations
 
+import asyncio
 import json
 import time
 
@@ -30,15 +31,38 @@ class MgrLite:
         self.name = "mgr"
         self.stale_secs = stale_secs
         self.reports: dict[int, dict] = {}  # osd -> {ts, epoch, perf, pgs}
+        self.config_mirror: dict[str, str] = {}  # "who/key" -> value
         self.admin: AdminSocket | None = None
+        self._sub_task: asyncio.Task | None = None
 
     # ---------------------------------------------------------- lifecycle
 
     async def start(self) -> None:
         self.bus.register(self.name, self.handle)
+        # keep the subscription alive across mon restarts/failovers: a
+        # new leader only learns subscribers that speak up, so a
+        # periodic idempotent re-subscribe is the liveness mechanism
+        self._sub_task = asyncio.get_running_loop().create_task(
+            self._subscribe_loop())
+
+    async def _subscribe_loop(self) -> None:
+        while True:
+            try:
+                await self.bus.send(self.name, "mon",
+                                    M.MMonSubscribe(what="osdmap"))
+            except Exception:
+                pass  # no mon yet / mid-election: retry next tick
+            await asyncio.sleep(1.0)
 
     async def stop(self) -> None:
         self.bus.unregister(self.name)
+        if self._sub_task is not None:
+            self._sub_task.cancel()
+            try:
+                await self._sub_task
+            except asyncio.CancelledError:
+                pass
+            self._sub_task = None
         if self.admin is not None:
             await self.admin.stop()
             self.admin = None
@@ -51,8 +75,45 @@ class MgrLite:
                       "health checks")
         sock.register("prometheus", lambda a: self.render_prometheus(),
                       "metrics exposition text")
+        sock.register("config set", self._admin_config_set,
+                      "central config: {who, key, value}")
+        sock.register("config dump", lambda a: self.config_mirror,
+                      "central config DB contents")
+        sock.register("balancer status", self._admin_balancer_status,
+                      "PG distribution for a pool: {pool}")
+        sock.register("balancer run", self._admin_balancer_run,
+                      "apply upmap moves: {pool, max_moves?}")
         await sock.start()
         self.admin = sock
+
+    # -------------------------------------------- config / balancer verbs
+
+    async def _admin_config_set(self, args: dict):
+        await self.bus.send(self.name, "mon", M.MConfigSet(
+            who=args["who"], key=args["key"], value=args["value"]))
+        return "ok"
+
+    async def _admin_balancer_status(self, args: dict):
+        from . import balancer
+
+        return balancer.spread(self.mon.osdmap, int(args["pool"]))
+
+    async def _admin_balancer_run(self, args: dict):
+        """One balancer round (the `ceph balancer execute` arc): plan
+        upmap moves, commit each through the mon, report the plan."""
+        from . import balancer
+
+        pool = int(args["pool"])
+        before = balancer.spread(self.mon.osdmap, pool)
+        moves = balancer.compute_moves(
+            self.mon.osdmap, pool, int(args.get("max_moves", 10)))
+        if moves:  # the whole plan rides one message -> one map epoch
+            await self.bus.send(self.name, "mon",
+                                M.MUpmapItems(entries=moves))
+        return {"moves": [
+            {"pgid": list(p), "pairs": [list(x) for x in pr]}
+            for p, pr in moves],
+            "before": before}
 
     async def handle(self, src: str, msg) -> None:
         if isinstance(msg, M.MMgrReport):
@@ -62,6 +123,9 @@ class MgrLite:
                 "perf": json.loads(msg.perf.decode() or "{}"),
                 "pgs": dict(msg.pgs),
             }
+        elif isinstance(msg, M.MConfig):
+            self.config_mirror = {
+                f"{w}/{k}": v for w, k, v in msg.entries}
 
     # ------------------------------------------------------------ surface
 

@@ -48,6 +48,8 @@ class MonLite:
         self.down_since: dict[int, float] = {}
         self.subscribers: set[str] = set()
         self.history: dict[int, bytes] = {}  # epoch -> encoded incremental
+        #: central config DB (ConfigMonitor role): (who, key) -> value
+        self.config_db: dict[tuple[str, str], str] = {}
         self._watchdog: asyncio.Task | None = None
         self._next_pool_id = 1
 
@@ -96,10 +98,15 @@ class MonLite:
         elif isinstance(msg, M.MMonSubscribe):
             self.subscribers.add(src)
             await self._send_map(src, 0)
+            await self._push_config(src)
         elif isinstance(msg, M.MFailure):
             await self._handle_failure(msg)
         elif isinstance(msg, M.MPoolCreate):
             await self._handle_pool_create(src, msg)
+        elif isinstance(msg, M.MConfigSet):
+            await self._handle_config_set(msg)
+        elif isinstance(msg, M.MUpmapItems):
+            await self._handle_upmap_items(msg)
 
     async def _handle_boot(self, src: str, msg: M.MOSDBoot) -> None:
         osd = msg.osd
@@ -119,6 +126,9 @@ class MonLite:
             await self.commit(inc)
         else:
             await self._send_map(src, 0)
+        # a (re)booting daemon starts with a fresh ConfigProxy: push the
+        # central DB so late joiners converge (MConfig-on-boot role)
+        await self._push_config(src)
 
     async def _handle_failure(self, msg: M.MFailure) -> None:
         """Peer-reported failure (send_failures -> prepare_failure role).
@@ -142,6 +152,42 @@ class MonLite:
             self.name, src,
             M.MPoolCreateReply(pool_id=pool.id, epoch=self.osdmap.epoch),
         )
+
+    # -------------------------------------------------------------- config
+
+    def _config_peers(self) -> list[str]:
+        """Peer mons that must mirror the config DB (PaxosMon
+        overrides; a single mon has none)."""
+        return []
+
+    async def _handle_config_set(self, msg: M.MConfigSet) -> None:
+        """Central config DB (ConfigMonitor role): record, mirror to
+        peer mons (so a failover keeps the DB — a peon down during the
+        set misses it, the lite analog of a store-sync gap), and push
+        to every subscriber as MConfig."""
+        self.config_db[(msg.who, msg.key)] = msg.value
+        for dst in list(self.subscribers) + self._config_peers():
+            await self._push_config(dst)
+
+    async def _push_config(self, dst: str) -> None:
+        if not self.config_db:
+            return
+        entries = [(w, k, v) for (w, k), v in sorted(
+            self.config_db.items())]
+        try:
+            await self.bus.send(self.name, dst,
+                                M.MConfig(entries=entries))
+        except Exception:
+            pass  # dead subscriber: dropped on next map churn
+
+    async def _handle_upmap_items(self, msg: M.MUpmapItems) -> None:
+        """pg-upmap-items verb (OSDMonitor role): commit the whole
+        plan as ONE map epoch (one re-peering pass, not one per PG)."""
+        inc = self._new_inc()
+        for pgid, pairs in msg.entries:
+            inc.new_pg_upmap_items[tuple(pgid)] = [
+                tuple(p) for p in pairs]
+        await self.commit(inc)
 
     # ---------------------------------------------------------------- maps
 

@@ -398,6 +398,19 @@ class OSDLite:
             self.op_scheduler.enqueue(
                 RECOVERY, lambda: pg.handle_scan(src, msg)
             )
+        elif isinstance(msg, M.MConfig):
+            # central config push (MConfig role): apply matching
+            # sections, most specific last
+            for who in ("global", "osd", f"osd.{self.id}"):
+                for w, key, value in msg.entries:
+                    if w != who:
+                        continue
+                    try:
+                        self.conf.set(key, value)
+                    except Exception as e:
+                        print(f"[{self.name}] config push "
+                              f"{key}={value!r} rejected: {e}",
+                              file=sys.stderr)
         elif isinstance(msg, M.MScrub):
             pg = self._ensure_pg(msg.pgid, msg.shard)
             self.op_scheduler.enqueue(

@@ -88,6 +88,9 @@ class PaxosMon(MonLite):
     def peers(self) -> list[int]:
         return [r for r in range(self.n_mons) if r != self.rank]
 
+    def _config_peers(self) -> list[str]:
+        return [f"mon.{r}" for r in self.peers()]
+
     async def start(self) -> None:
         self.bus.register(self.name, self.handle)
         self._watchdog = asyncio.get_running_loop().create_task(
@@ -287,7 +290,12 @@ class PaxosMon(MonLite):
         elif isinstance(msg, M.MMonGetMap):
             self.subscribers.add(src)
             await super().handle(src, msg)
-        elif isinstance(msg, (M.MOSDBoot, M.MFailure, M.MPoolCreate)):
+        elif isinstance(msg, M.MConfig):
+            # leader's config mirror (ConfigMonitor paxos-store role):
+            # a peon that later wins an election keeps serving the DB
+            self.config_db = {(w, k): v for w, k, v in msg.entries}
+        elif isinstance(msg, (M.MOSDBoot, M.MFailure, M.MPoolCreate,
+                              M.MConfigSet, M.MUpmapItems)):
             # map-mutating requests: a peon forwards to the leader
             # (Monitor::forward_request_leader role); commits that race
             # a leadership change fail quietly and the requester retries
