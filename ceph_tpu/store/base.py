@@ -155,8 +155,12 @@ class ObjectStore:
         if c is None:
             raise NotFound(f"collection {op.cid}")
         a = op.args
+        # read-only lookups: peek avoids dragging untouched objects
+        # through a staged overlay's copy-on-touch (plain dicts: get)
+        peek = getattr(c.objects, "peek", c.objects.get)
         if op.code == tx.OP_TOUCH:
-            c.objects.setdefault(op.oid, Obj())
+            if peek(op.oid) is None:
+                c.objects[op.oid] = Obj()
             return
         if op.code == tx.OP_REMOVE:
             if op.oid not in c.objects:
@@ -164,13 +168,13 @@ class ObjectStore:
             del c.objects[op.oid]
             return
         if op.code == tx.OP_CLONE:
-            src = c.objects.get(op.oid)
+            src = peek(op.oid)
             if src is None:
                 raise NotFound(repr(op.oid))
             c.objects[a["dest"]] = src.clone()
             return
         if op.code == tx.OP_CLONERANGE:
-            src = c.objects.get(op.oid)
+            src = peek(op.oid)
             if src is None:
                 raise NotFound(repr(op.oid))
             dst = c.objects.setdefault(a["dest"], Obj())

@@ -115,9 +115,9 @@ class WalStore(MemStore):
         if not self._mounted:
             raise StoreError("not mounted")
         with self.lock:
-            # PREPARE: validate + apply to a shadow (all-or-nothing); a
-            # rejected transaction must never reach the log
-            shadow = self._apply_to_shadow(t)
+            # PREPARE: validate + stage copy-on-touch (all-or-nothing);
+            # a rejected transaction must never reach the log
+            staging = self._stage(t)
             seq = self._seq + 1
             body = denc.enc_u64(seq) + t.encode()
             rec = (
@@ -133,7 +133,7 @@ class WalStore(MemStore):
             if self.fsync:
                 os.fsync(self._wal.fileno())
             self._wal_size += len(rec)
-            self.colls = shadow
+            self._commit_stage(staging)
             self._seq = seq
         if on_commit:
             on_commit()
