@@ -1,0 +1,123 @@
+"""Snapshot model: SnapSet, clone bookkeeping, removed-snap intervals.
+
+The SnapContext / SnapSet / SnapMapper data model of the reference
+(src/osd/osd_types.h SnapSet, src/osd/SnapMapper.h, src/common/
+interval_set.h), reduced to what the lite data path needs:
+
+- A write carries a SnapContext ``(seq, snaps)``: ``seq`` is the most
+  recent snapshot id the writer has seen, ``snaps`` the existing snap
+  ids in descending order (librados::IoCtx::selfmanaged_snap_set_write_ctx
+  role).
+- Each head object has a SnapSet: the seq at its last clone and the
+  list of clones. A clone is made lazily on the first write after a new
+  snap (PrimaryLogPG::make_writeable role); ``snaps`` records exactly
+  which snap ids the clone preserves.
+- Pool-level removed snaps are an interval set of half-open ``[lo, hi)``
+  ranges (pg_pool_t::removed_snaps); snap trimming subtracts them from
+  clone snap lists and deletes clones left covering nothing.
+
+Snap id space: 1.. ; NOSNAP (reads of the head) is 2**64 - 2, matching
+CEPH_NOSNAP's "biggest ordinary value" role.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ..utils import denc
+
+NOSNAP = 2**64 - 2
+
+
+@dataclass
+class Clone:
+    cloneid: int                      # newest snap preserved (names the clone)
+    snaps: list[int] = field(default_factory=list)  # descending, exact set
+    size: int = 0                     # head size at clone time
+
+
+@dataclass
+class SnapSet:
+    seq: int = 0                      # snap seq at the last clone
+    clones: list[Clone] = field(default_factory=list)  # ascending cloneid
+
+    def encode(self) -> bytes:
+        return denc.enc_u64(self.seq) + denc.enc_list(
+            self.clones,
+            lambda c: (denc.enc_u64(c.cloneid)
+                       + denc.enc_list(c.snaps, denc.enc_u64)
+                       + denc.enc_u64(c.size)),
+        )
+
+    @classmethod
+    def decode(cls, buf: bytes, off: int = 0) -> tuple["SnapSet", int]:
+        seq, off = denc.dec_u64(buf, off)
+
+        def one(b, o):
+            cid, o = denc.dec_u64(b, o)
+            snaps, o = denc.dec_list(b, o, denc.dec_u64)
+            size, o = denc.dec_u64(b, o)
+            return Clone(cid, snaps, size), o
+
+        clones, off = denc.dec_list(buf, off, one)
+        return cls(seq, clones), off
+
+    def resolve(self, snapid: int) -> int | None:
+        """Which clone serves a read at ``snapid``? Returns the cloneid,
+        or NOSNAP when the head covers it (snapid newer than every
+        clone), or None when no copy covers that snap (the object was
+        created after it, or the clone range skips it).
+
+        A clone named C covers the snap range (prev_cloneid, C] — the
+        find-first-clone->=snap walk of PrimaryLogPG::find_object_context.
+        """
+        if snapid == NOSNAP:
+            return NOSNAP
+        prev = 0
+        for c in self.clones:
+            if c.cloneid >= snapid:
+                return c.cloneid if snapid > prev else None
+            prev = c.cloneid
+        return NOSNAP  # newer than all clones: head serves it
+
+
+# ------------------------------------------------------- interval sets
+
+
+def interval_insert(ivals: list[tuple[int, int]], lo: int,
+                    hi: int) -> list[tuple[int, int]]:
+    """Union [lo, hi) into a sorted disjoint interval list."""
+    out: list[tuple[int, int]] = []
+    placed = False
+    for a, b in ivals:
+        if b < lo or a > hi:          # disjoint (touching merges)
+            if a > hi and not placed:
+                out.append((lo, hi))
+                placed = True
+            out.append((a, b))
+        else:                         # overlap/adjacent: absorb
+            lo, hi = min(lo, a), max(hi, b)
+    if not placed:
+        out.append((lo, hi))
+    out.sort()
+    return out
+
+
+def interval_contains(ivals: list[tuple[int, int]], x: int) -> bool:
+    for a, b in ivals:
+        if a <= x < b:
+            return True
+        if a > x:
+            break
+    return False
+
+
+def interval_diff_ids(new: list[tuple[int, int]],
+                      old: list[tuple[int, int]]) -> list[int]:
+    """Snap ids in ``new`` but not in ``old`` (drives trimming after a
+    map change). Interval widths here are tiny (one id per removal)."""
+    out = []
+    for a, b in new:
+        for x in range(a, b):
+            if not interval_contains(old, x):
+                out.append(x)
+    return out
