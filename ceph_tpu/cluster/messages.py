@@ -209,9 +209,16 @@ class MECSubWrite(Message):
         ("txn", "bytes"),
         ("entry", "bytes"),
         ("epoch", "u32"),
+        # RMW metadata (ECUtil hash_info role): per-cell CRC patches as
+        # concat LE (u32 cell, u32 crc) pairs, the shard file's new cell
+        # count, and the logical object size. Empty hpatch + ncells=0 =
+        # the txn carries full attrs itself (delete / recovery install).
+        ("hpatch", "bytes"),
+        ("ncells", "u64"),
+        ("size", "u64"),
         ("trace", "pair:u64:u64"),  # span ctx (utils/trace; 0,0 = off)
     )
-    DEFAULTS = {"trace": (0, 0)}
+    DEFAULTS = {"trace": (0, 0), "hpatch": b"", "ncells": 0, "size": 0}
 
 
 @register_message

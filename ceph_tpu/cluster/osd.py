@@ -50,40 +50,38 @@ class ECBatcher:
         self._flushing = False
         self.perf = perf
 
-    async def encode(self, codec, data: bytes) -> dict[int, np.ndarray]:
-        """-> {chunk_index: uint8 chunk} for one stripe; batches with
-        every other stripe submitted in the same tick."""
+    async def encode_cells(self, codec, cells: np.ndarray) -> np.ndarray:
+        """(B, k, su) uint8 data cells -> (B, m, su) uint8 parity cells.
+
+        The fixed stripe_unit layout (cluster/stripe.py) means every
+        caller in the cluster shares one cell shape, so stripes from
+        different objects/PGs submitted in the same reactor tick merge
+        into ONE device dispatch of ONE compiled kernel shape."""
         from ..ops import rs
 
-        blocksize = codec.get_chunk_size(len(data))
-        padded = np.zeros(blocksize * codec.k, dtype=np.uint8)
-        padded[: len(data)] = np.frombuffer(data, dtype=np.uint8)
-        stripe = rs.pack_u32(padded.reshape(codec.k, blocksize))
-        key = (id(codec), blocksize)
+        stripes = rs.pack_u32(np.ascontiguousarray(cells))  # (B, k, W/4)
+        key = (id(codec), cells.shape[-1])
         fut = asyncio.get_running_loop().create_future()
-        self._pending.setdefault(key, []).append((codec, stripe, fut))
+        self._pending.setdefault(key, []).append((codec, stripes, fut))
         if not self._flushing:
             self._flushing = True
             asyncio.get_running_loop().call_soon(self._flush)
-        chunks_u32 = await fut
-        if chunks_u32 is _FAILED:
+        parity_u32 = await fut
+        if parity_u32 is _FAILED:
             raise RuntimeError("batched encode failed")
-        out = {}
-        for j in range(codec.get_chunk_count()):
-            out[codec.chunk_index(j)] = rs.unpack_u32(chunks_u32[j])
-        return out
+        return rs.unpack_u32(parity_u32)
 
     def _flush(self) -> None:
         from ..ops import rs
 
         self._flushing = False
         pending, self._pending = self._pending, {}
-        for (_cid, _bs), items in pending.items():
+        for (_cid, _su), items in pending.items():
             codec = items[0][0]
-            batch = np.stack([stripe for _, stripe, _ in items])
+            batch = np.concatenate([stripes for _, stripes, _ in items])
             if self.perf is not None:
                 self.perf.inc("ec_batches")
-                self.perf.observe("ec_batch_stripes", len(items))
+                self.perf.observe("ec_batch_stripes", len(batch))
             try:
                 parity = np.asarray(codec.encode_batch(batch))
             except Exception:
@@ -91,10 +89,12 @@ class ECBatcher:
                     if not fut.done():
                         fut.set_result(_FAILED)
                 continue
-            for i, (_, stripe, fut) in enumerate(items):
-                full = np.concatenate([stripe, parity[i]], axis=0)
+            row = 0
+            for _, stripes, fut in items:
+                b = len(stripes)
                 if not fut.done():
-                    fut.set_result(full)
+                    fut.set_result(parity[row : row + b])
+                row += b
 
 
 class OSDLite:
@@ -140,6 +140,7 @@ class OSDLite:
         self.pending: dict = {}  # key -> Future (sub-op replies)
         self._subtid = 0
         self._codecs: dict[int, object] = {}
+        self._sinfos: dict[int, object] = {}
         self._hb_task: asyncio.Task | None = None
         self._worker_task: asyncio.Task | None = None
         self._tasks: set[asyncio.Task] = set()
@@ -239,6 +240,31 @@ class OSDLite:
             codec = load_codec(dict(pool.ec_profile))
             self._codecs[pool.id] = codec
         return codec
+
+    def sinfo_for(self, pool):
+        """StripeInfo of an EC pool (stripe_unit from the profile,
+        rounded to the codec's cell alignment)."""
+        si = self._sinfos.get(pool.id)
+        if si is None:
+            from . import stripe as st
+
+            codec = self.codec_for(pool)
+            if not getattr(codec, "bytewise_linear", False):
+                # the striped RMW data path slices chunks into cells,
+                # which is only a valid codeword transform for bytewise
+                # GF-matrix codes (rs_plugin.py); packetized codecs
+                # (bitmatrix, CLAY) would decode garbage
+                raise ValueError(
+                    f"EC profile {pool.ec_profile.get('plugin')!r} does "
+                    "not support the striped data path (pool "
+                    f"{pool.name!r}); use a reed-solomon matrix profile"
+                )
+            req = int(pool.ec_profile.get("stripe_unit",
+                                          st.DEFAULT_STRIPE_UNIT))
+            su = st.effective_stripe_unit(codec, req)
+            si = st.StripeInfo(codec.k, codec.m, su)
+            self._sinfos[pool.id] = si
+        return si
 
     # ---------------------------------------------------------- lifecycle
 

@@ -37,6 +37,7 @@ from ..store import transaction as tx
 from ..utils import denc
 from ..utils import trace as tr
 from . import messages as M
+from . import stripe as st
 from .pglog import OP_DELETE, OP_MODIFY, ZERO, Entry, PGInfo, PGLog
 
 
@@ -77,31 +78,6 @@ class OpError(Exception):
         self.code = code
 
 
-def _object_mutation(t: tx.Transaction, cid: str, oid: bytes,
-                     payload: bytes | None, version,
-                     attrs: dict[str, bytes], state: dict | None,
-                     existed: bool) -> None:
-    """Shared shape of one object mutation: full-state replace (data +
-    internal attrs + user xattrs + omap) or removal."""
-    if payload is None:
-        if existed:
-            t.remove(cid, oid)
-        return
-    t.truncate(cid, oid, 0)
-    t.write(cid, oid, 0, payload)
-    full_attrs = {ATTR_V: enc_ver(version), **attrs}
-    if state is not None:
-        t.rmattrs(cid, oid)
-        for k, v in state["xattrs"].items():
-            full_attrs[USER_ATTR + k] = v
-        t.omap_clear(cid, oid)
-        if state["omap"]:
-            t.omap_setkeys(cid, oid, state["omap"])
-        if state["omap_header"]:
-            t.omap_setheader(cid, oid, state["omap_header"])
-    t.setattrs(cid, oid, full_attrs)
-
-
 def enc_ver(v: tuple[int, int]) -> bytes:
     return denc.enc_u32(v[0]) + denc.enc_u64(v[1])
 
@@ -110,6 +86,222 @@ def dec_ver(b: bytes) -> tuple[int, int]:
     e, off = denc.dec_u32(b, 0)
     s, _ = denc.dec_u64(b, off)
     return (e, s)
+
+
+class _OpState:
+    """Lazy working state of one op vector (the ObjectContext role).
+
+    Data mutations accumulate in a stripe.Overlay instead of a
+    materialized copy, so a plain write never reads the object — the
+    backends turn the overlay into op-granular transactions (the
+    reference ships the transaction, not the object:
+    ReplicatedBackend.cc:465, ECBackend.cc:1898).  Old facets (data,
+    xattrs, omap) load on demand only when an op actually reads them;
+    a cls call materializes everything and flips ``full_replace``.
+    """
+
+    def __init__(self, pg: "PG", oid: bytes):
+        self.pg = pg
+        self.oid = oid
+        self.exists0 = False
+        self.size0 = 0
+        self.ov: st.Overlay | None = None
+        self._xattrs: dict[str, bytes] | None = None
+        self.xattr_muts: list[tuple] = []  # ("set", k, v) | ("rm", k)
+        self._omap: dict[bytes, bytes] | None = None
+        self._omap_header: bytes | None = None
+        self.omap_muts: list[tuple] = []
+        self._data: bytearray | None = None
+        self.full_replace = False
+        self.mutated = False
+        self.deleted = False
+
+    async def init(self) -> None:
+        pg, oid = self.pg, self.oid
+        store = pg.osd.store
+        if pg.is_ec:
+            try:
+                raw = store.getattr(pg.cid, oid, ATTR_SIZE)
+                self.exists0 = True
+                self.size0 = denc.dec_u64(raw, 0)[0]
+            except Exception:
+                meta = await pg._ec_remote_meta(oid)
+                if meta is not None:
+                    self.exists0 = True
+                    self.size0, attrs = meta
+                    self._xattrs = {
+                        k[len(USER_ATTR):]: v for k, v in attrs.items()
+                        if k.startswith(USER_ATTR)
+                    }
+        else:
+            try:
+                self.size0 = store.stat(pg.cid, oid)
+                self.exists0 = True
+            except NotFound:
+                pass
+        self.ov = st.Overlay(self.size0 if self.exists0 else 0)
+
+    # ------------------------------------------------------- data facet
+
+    @property
+    def size(self) -> int:
+        return self.ov.size
+
+    async def materialize(self) -> bytearray:
+        """Old data + overlay, loaded once; later data ops keep it in
+        sync so intra-vector reads see earlier writes."""
+        if self._data is None:
+            if self.exists0:
+                if self.pg.is_ec:
+                    old, _ = await self.pg._read_ec(self.oid, 0,
+                                                    self.size0)
+                else:
+                    old = self.pg.osd.store.read(self.pg.cid, self.oid)
+            else:
+                old = b""
+            self._data = self.ov.apply(old)
+        return self._data
+
+    async def read_range(self, offset: int, length: int) -> bytes:
+        """[offset, offset+length) (length<0 = to end). When nothing is
+        materialized and no data mutation is pending, this is a ranged
+        fetch — an EC object read moves O(range), not O(object)."""
+        if self._data is None and self.ov.empty:
+            end = self.size if length < 0 else min(offset + length,
+                                                   self.size)
+            if end <= offset or not self.exists0:
+                return b""
+            if self.pg.is_ec:
+                data, _sz = await self.pg._read_ec(self.oid, offset,
+                                                   end - offset)
+                return data
+            return bytes(self.pg.osd.store.read(self.pg.cid, self.oid,
+                                                offset, end - offset))
+        data = await self.materialize()
+        if length < 0:
+            return bytes(data[offset:])
+        return bytes(data[offset : offset + length])
+
+    def write(self, offset: int, payload: bytes) -> None:
+        self.ov.write(offset, payload)
+        if self._data is not None:
+            end = offset + len(payload)
+            if len(self._data) < end:
+                self._data.extend(b"\0" * (end - len(self._data)))
+            self._data[offset:end] = payload
+
+    def zero(self, offset: int, length: int) -> None:
+        self.ov.zero(offset, length)
+        if self._data is not None:
+            end = offset + length
+            if len(self._data) < end:
+                self._data.extend(b"\0" * (end - len(self._data)))
+            self._data[offset:end] = b"\0" * length
+
+    def truncate(self, size: int) -> None:
+        self.ov.truncate(size)
+        if self._data is not None:
+            if size < len(self._data):
+                del self._data[size:]
+            else:
+                self._data.extend(b"\0" * (size - len(self._data)))
+
+    # ------------------------------------------------------ attr facets
+
+    def xattrs(self) -> dict[str, bytes]:
+        """Loaded on first READ only (blind updates just record muts);
+        pending muts replay on top of the stored set."""
+        if self._xattrs is None:
+            pg = self.pg
+            try:
+                attrs = pg.osd.store.getattrs(pg.cid, self.oid)
+                self._xattrs = {
+                    k[len(USER_ATTR):]: v for k, v in attrs.items()
+                    if k.startswith(USER_ATTR)
+                }
+            except NotFound:
+                self._xattrs = {}
+            for m_ in self.xattr_muts:
+                if m_[0] == "set":
+                    self._xattrs[m_[1]] = m_[2]
+                else:
+                    self._xattrs.pop(m_[1], None)
+        return self._xattrs
+
+    def setxattr(self, k: str, v: bytes) -> None:
+        if self._xattrs is not None:
+            self._xattrs[k] = v
+        self.xattr_muts.append(("set", k, v))
+
+    def rmxattr(self, k: str) -> None:
+        if self._xattrs is not None:
+            self._xattrs.pop(k, None)
+        self.xattr_muts.append(("rm", k))
+
+    def omap(self) -> dict[bytes, bytes]:
+        if self._omap is None:
+            pg = self.pg
+            try:
+                self._omap = pg.osd.store.omap_get(pg.cid, self.oid)
+            except NotFound:
+                self._omap = {}
+            for kind, arg in self.omap_muts:
+                if kind == "setkeys":
+                    self._omap.update(arg)
+                elif kind == "rmkeys":
+                    for k in arg:
+                        self._omap.pop(k, None)
+                elif kind == "clear":
+                    self._omap.clear()
+        return self._omap
+
+    def omap_header(self) -> bytes:
+        if self._omap_header is None:
+            pg = self.pg
+            try:
+                hdr = pg.osd.store.omap_get_header(pg.cid, self.oid)
+            except NotFound:
+                hdr = b""
+            for kind, arg in self.omap_muts:
+                if kind == "setheader":
+                    hdr = arg
+                elif kind == "clear":
+                    hdr = b""
+            self._omap_header = hdr
+        return self._omap_header
+
+    def omap_setkeys(self, kv: dict) -> None:
+        if self._omap is not None:
+            self._omap.update(kv)
+        self.omap_muts.append(("setkeys", dict(kv)))
+
+    def omap_rmkeys(self, keys) -> None:
+        if self._omap is not None:
+            for k in keys:
+                self._omap.pop(k, None)
+        self.omap_muts.append(("rmkeys", list(keys)))
+
+    def omap_set_header(self, header: bytes) -> None:
+        self._omap_header = header
+        self.omap_muts.append(("setheader", header))
+
+    def omap_clear(self) -> None:
+        self._omap = {}
+        self._omap_header = b""
+        self.omap_muts.append(("clear", None))
+
+    # ---------------------------------------------------- cls interface
+
+    async def state_dict(self) -> dict:
+        """Materialized full state for a cls method (objclass role)."""
+        data = await self.materialize()
+        return {
+            "data": data,
+            "xattrs": self.xattrs(),
+            "omap": self.omap() if not self.pg.is_ec else {},
+            "omap_header": (self.omap_header()
+                            if not self.pg.is_ec else b""),
+        }
 
 
 class PG:
@@ -307,114 +499,96 @@ class PG:
 
     async def _execute_ops(self, oid: bytes, ops,
                            src: str = "") -> tuple[list, int]:
-        """Apply the op vector against a working copy of the object
-        (do_osd_ops role): reads inside the vector see earlier writes,
-        mutations commit atomically at the end, any failure aborts the
-        whole vector. Returns ([(result, data)] per op, object size)."""
-        state = await self._load_object_state(oid)
-        exists0 = state is not None
-        if state is None:
-            state = {"data": bytearray(), "xattrs": {}, "omap": {},
-                     "omap_header": b""}
-        data = state["data"]
+        """Apply the op vector against a lazy working state of the
+        object (do_osd_ops role): reads inside the vector see earlier
+        writes, mutations commit atomically at the end, any failure
+        aborts the whole vector. Data mutations accumulate as an
+        overlay so the backends ship deltas, not the object. Returns
+        ([(result, data)] per op, object size)."""
+        st8 = _OpState(self, oid)
+        await st8.init()
         outs: list[tuple[int, bytes]] = []
-        mutated = False
-        deleted = False
         for (op, offset, length, key, payload, kv, keys) in ops:
             out = b""
             if op in WRITE_OPS:
-                mutated = True
+                st8.mutated = True
             if op == "read":
-                if not exists0 and not mutated:
+                if not st8.exists0 and not st8.mutated:
                     raise OpError(M.ENOENT)
-                if length < 0:
-                    out = bytes(data[offset:])
-                else:
-                    out = bytes(data[offset : offset + length])
+                out = await st8.read_range(offset, length)
             elif op == "stat":
-                if not exists0 and not mutated:
+                if not st8.exists0 and not st8.mutated:
                     raise OpError(M.ENOENT)
-                out = denc.enc_u64(len(data))
+                out = denc.enc_u64(st8.size)
             elif op == "getxattr":
-                self._check_exists(exists0, mutated)
+                self._check_exists(st8.exists0, st8.mutated)
                 k = key.decode()
-                if k not in state["xattrs"]:
+                if k not in st8.xattrs():
                     raise OpError(ENODATA, f"xattr {k}")
-                out = state["xattrs"][k]
+                out = st8.xattrs()[k]
             elif op == "getxattrs":
-                self._check_exists(exists0, mutated)
-                out = denc.enc_map(state["xattrs"], denc.enc_str,
+                self._check_exists(st8.exists0, st8.mutated)
+                out = denc.enc_map(st8.xattrs(), denc.enc_str,
                                    denc.enc_bytes)
             elif op == "omap_get":
                 self._check_omap()
-                self._check_exists(exists0, mutated)
-                out = denc.enc_map(state["omap"], denc.enc_bytes,
+                self._check_exists(st8.exists0, st8.mutated)
+                out = denc.enc_map(st8.omap(), denc.enc_bytes,
                                    denc.enc_bytes)
             elif op == "omap_getheader":
                 self._check_omap()
-                self._check_exists(exists0, mutated)
-                out = state["omap_header"]
+                self._check_exists(st8.exists0, st8.mutated)
+                out = st8.omap_header()
             elif op == "omap_getkeys":
                 self._check_omap()
-                self._check_exists(exists0, mutated)
-                out = denc.enc_list(sorted(state["omap"]), denc.enc_bytes)
+                self._check_exists(st8.exists0, st8.mutated)
+                out = denc.enc_list(sorted(st8.omap()), denc.enc_bytes)
             elif op == "writefull":
-                data[:] = payload
-                deleted = False
+                st8.truncate(0)
+                st8.write(0, payload)
+                st8.deleted = False
             elif op == "write":
-                end = offset + len(payload)
-                if len(data) < end:
-                    data.extend(b"\0" * (end - len(data)))
-                data[offset:end] = payload
+                st8.write(offset, payload)
             elif op == "append":
-                data.extend(payload)
+                st8.write(st8.size, payload)
             elif op == "zero":
-                end = offset + length
-                if len(data) < end:
-                    data.extend(b"\0" * (end - len(data)))
-                data[offset:end] = b"\0" * length
+                st8.zero(offset, length)
             elif op == "truncate":
-                size = offset
-                if size < len(data):
-                    del data[size:]
-                else:
-                    data.extend(b"\0" * (size - len(data)))
+                st8.truncate(offset)
             elif op == "create":
-                if exists0 and length == 0:  # length 0 = exclusive
+                if st8.exists0 and length == 0:  # length 0 = exclusive
                     raise OpError(EEXIST)
             elif op == "delete":
-                if not exists0 and not mutated:
+                if not st8.exists0 and not st8.mutated:
                     raise OpError(M.ENOENT)
-                deleted = True
+                st8.deleted = True
             elif op == "setxattr":
-                state["xattrs"][key.decode()] = payload
+                st8.setxattr(key.decode(), payload)
             elif op == "rmxattr":
-                state["xattrs"].pop(key.decode(), None)
+                st8.rmxattr(key.decode())
             elif op == "omap_setkeys":
                 self._check_omap()
-                state["omap"].update(kv)
+                st8.omap_setkeys(kv)
             elif op == "omap_rmkeys":
                 self._check_omap()
-                for k in keys:
-                    state["omap"].pop(k, None)
+                st8.omap_rmkeys(keys)
             elif op == "omap_setheader":
                 self._check_omap()
-                state["omap_header"] = payload
+                st8.omap_set_header(payload)
             elif op == "omap_clear":
                 self._check_omap()
-                state["omap"].clear()
-                state["omap_header"] = b""
+                st8.omap_clear()
             elif op == "watch":
                 # register/unregister src as a watcher (librados watch
                 # role; offset carries the cookie, length 0 = unwatch)
-                self._check_exists(exists0, mutated)
+                self._check_exists(st8.exists0, st8.mutated)
                 ws = self.watchers.setdefault(oid, set())
                 if length == 0:
                     ws.discard((src, offset))
                 else:
                     ws.add((src, offset))
             elif op == "notify":
-                self._check_exists(exists0, mutated)
+                self._check_exists(st8.exists0, st8.mutated)
                 self._notify_id += 1
                 nid = self._notify_id
                 for entity, cookie in self.watchers.get(oid, set()):
@@ -439,36 +613,34 @@ class PG:
                         EOPNOTSUPP, f"no class method {key.decode()!r}"
                     )
                 fn, _flags = entry
-                ctx = cls_mod.ClsContext(state, exists0 or mutated)
+                ctx = cls_mod.ClsContext(
+                    await st8.state_dict(), st8.exists0 or st8.mutated
+                )
                 try:
                     out = fn(ctx, payload)
                 except cls_mod.ClsError as e:
                     raise OpError(e.code, str(e)) from None
                 if ctx.mutated:
-                    mutated = True
+                    # the class mutated arbitrary facets outside the
+                    # overlay: commit as a full-state replace
+                    st8.mutated = True
+                    st8.full_replace = True
+                    st8.ov.size = len(st8._data)
                 if ctx.removed:
-                    deleted = True
+                    st8.deleted = True
             else:
                 raise OpError(EOPNOTSUPP, f"op {op!r}")
             outs.append((M.OK, out))
-        if mutated:
+        if st8.mutated:
             version = self.next_version()
             prior = self._object_version(oid)
-            if deleted:
-                entry = Entry(OP_DELETE, oid, version, prior)
-                if self.is_ec:
-                    await self._write_ec(oid, None, entry)
-                else:
-                    await self._write_replicated(oid, None, entry)
+            op_kind = OP_DELETE if st8.deleted else OP_MODIFY
+            entry = Entry(op_kind, oid, version, prior)
+            if self.is_ec:
+                await self._write_ec_rmw(oid, st8, entry)
             else:
-                entry = Entry(OP_MODIFY, oid, version, prior)
-                if self.is_ec:
-                    await self._write_ec(oid, bytes(data), entry,
-                                         state=state)
-                else:
-                    await self._write_replicated(oid, bytes(data), entry,
-                                                 state=state)
-        return outs, len(data) if not deleted else 0
+                await self._write_replicated(oid, st8, entry)
+        return outs, st8.size if not st8.deleted else 0
 
     @staticmethod
     def _check_exists(exists0: bool, mutated: bool) -> None:
@@ -480,171 +652,383 @@ class PG:
             # EC pools do not support omap (the reference restriction)
             raise OpError(EOPNOTSUPP, "omap on EC pool")
 
-    async def _load_object_state(self, oid: bytes):
-        """Current object facets, or None when absent. Replicated reads
-        come from the primary's store; EC data reconstructs via
-        _read_ec, metadata from the primary's own shard."""
-        store = self.osd.store
-        if not self.is_ec:
-            try:
-                data = bytearray(store.read(self.cid, oid))
-            except NotFound:
-                return None
-            attrs = store.getattrs(self.cid, oid)
-            return {
-                "data": data,
-                "xattrs": {k[len(USER_ATTR):]: v for k, v in attrs.items()
-                           if k.startswith(USER_ATTR)},
-                "omap": store.omap_get(self.cid, oid),
-                "omap_header": store.omap_get_header(self.cid, oid),
-            }
-        try:
-            data, _size = await self._read_ec(oid)
-        except KeyError:
-            return None
-        xattrs = {}
-        try:
-            attrs = store.getattrs(self.cid, oid)
-            xattrs = {k[len(USER_ATTR):]: v for k, v in attrs.items()
-                      if k.startswith(USER_ATTR)}
-        except NotFound:
-            pass
-        return {"data": bytearray(data), "xattrs": xattrs, "omap": {},
-                "omap_header": b""}
-
     def _object_version(self, oid: bytes) -> tuple[int, int]:
         try:
             return dec_ver(self.osd.store.getattr(self.cid, oid, ATTR_V))
         except Exception:
             return ZERO
 
-    def _local_txn(self, oid: bytes, payload: bytes | None,
-                   version, attrs: dict[str, bytes],
-                   entry: Entry, state: dict | None = None
-                   ) -> tx.Transaction:
+    # ------------------------------------------------ replicated backend
+
+    def _rep_mutation_txn(self, cid: str, oid: bytes, st8: _OpState,
+                          version) -> tx.Transaction:
+        """Op-granular mutation transaction — what ships to replicas
+        (the ReplicatedBackend.cc:465 role: the transaction, never the
+        object). The primary applies the identical ops locally."""
         t = tx.Transaction()
-        self._ensure_coll(t)
-        _object_mutation(t, self.cid, oid, payload, version, attrs, state,
-                         existed=self.osd.store.exists(self.cid, oid))
-        self._append_and_persist(entry, t)
+        if st8.deleted:
+            t.remove(cid, oid)
+            return t
+        if st8.full_replace:
+            # a cls method rebuilt arbitrary facets: replace everything
+            t.truncate(cid, oid, 0)
+            t.write(cid, oid, 0, bytes(st8._data))
+            t.rmattrs(cid, oid)
+            attrs = {ATTR_V: enc_ver(version)}
+            for k, v in st8.xattrs().items():
+                attrs[USER_ATTR + k] = v
+            t.setattrs(cid, oid, attrs)
+            t.omap_clear(cid, oid)
+            if st8._omap:
+                t.omap_setkeys(cid, oid, st8._omap)
+            t.omap_setheader(cid, oid, st8._omap_header or b"")
+            return t
+        ov = st8.ov
+        if not st8.exists0:
+            t.touch(cid, oid)
+        if ov.size < st8.size0:
+            t.truncate(cid, oid, ov.size)
+        for off, p in ov.extents():
+            if off >= ov.size:
+                continue
+            ln = p if isinstance(p, int) else len(p)
+            ln = min(ln, ov.size - off)
+            if isinstance(p, int):
+                t.zero(cid, oid, off, ln)
+            else:
+                t.write(cid, oid, off, p[:ln])
+        for m_ in st8.xattr_muts:
+            if m_[0] == "set":
+                t.setattr(cid, oid, USER_ATTR + m_[1], m_[2])
+            else:
+                t.rmattr(cid, oid, USER_ATTR + m_[1])
+        for kind, arg in st8.omap_muts:
+            if kind == "setkeys":
+                t.omap_setkeys(cid, oid, arg)
+            elif kind == "rmkeys":
+                t.omap_rmkeys(cid, oid, arg)
+            elif kind == "setheader":
+                t.omap_setheader(cid, oid, arg)
+            elif kind == "clear":
+                t.omap_clear(cid, oid)
+                t.omap_setheader(cid, oid, b"")
+        t.setattr(cid, oid, ATTR_V, enc_ver(version))
         return t
 
-    @staticmethod
-    def _remote_txn(cid: str, oid: bytes, payload: bytes | None,
-                    version, attrs: dict[str, bytes],
-                    state: dict | None = None) -> tx.Transaction:
-        """Transaction shipped to a peer (its PG appends the log entry and
-        persists it into the same transaction on arrival)."""
-        t = tx.Transaction()
-        _object_mutation(t, cid, oid, payload, version, attrs, state,
-                         existed=True)
-        return t
-
-    async def _write_replicated(self, oid: bytes, data: bytes | None,
-                                entry: Entry, state: dict | None = None
-                                ) -> None:
+    async def _write_replicated(self, oid: bytes, st8: _OpState,
+                                entry: Entry) -> None:
         version = entry.version
         peers = [(o, s) for o, s in self.live_members()
                  if o != self.osd.id]
+        mut = self._rep_mutation_txn(self.cid, oid, st8, version)
         # local apply first (primary orders), then fan out, ack on all
-        self.osd.store.queue_transaction(
-            self._local_txn(oid, data, version, {}, entry, state=state)
-        )
-        await self._fanout_rep(peers, oid, data, version, entry, state)
-
-    async def _fanout_rep(self, peers, oid, data, version, entry,
-                          state=None) -> None:
+        local = tx.Transaction()
+        self._ensure_coll(local)
+        local.ops.extend(self._filter_remote_ops(mut))
+        self._append_and_persist(entry, local)
+        self.osd.store.queue_transaction(local)
+        enc_txn = mut.encode()
         waits = []
         for o, _s in peers:
-            rt = self._remote_txn(f"{self.pgid[0]}.{self.pgid[1]}", oid,
-                                  data, version, {}, state=state)
             subtid = self.osd.new_subtid()
             fut = self.osd.expect_reply(subtid)
             waits.append((o, subtid, fut))
             await self.osd.send(
                 f"osd.{o}",
-                M.MOSDRepOp(tid=subtid, pgid=self.pgid, txn=rt.encode(),
+                M.MOSDRepOp(tid=subtid, pgid=self.pgid, txn=enc_txn,
                             entry=entry.encode(),
                             epoch=self.osd.osdmap.epoch,
                             trace=_trace_ctx()),
             )
         await self.osd.gather(waits)
 
-    async def _write_ec(self, oid: bytes, data: bytes | None,
-                        entry: Entry, state: dict | None = None) -> None:
-        version = entry.version
-        codec = self.osd.codec_for(self.pool)
+    # -------------------------------------------------------- EC backend
+
+    def _shard_cid(self, pos: int) -> str:
+        return f"{self.pgid[0]}.{self.pgid[1]}s{pos}"
+
+    async def _write_ec_rmw(self, oid: bytes, st8: _OpState,
+                            entry: Entry) -> None:
+        """EC delta write (ECBackend.cc:1898 start_rmw role): read the
+        touched stripes' old data, re-encode ONLY those stripes (one
+        batched device dispatch), ship per-cell deltas + CRC patches to
+        each shard. A whole-object write is the degenerate case where
+        every stripe is touched; a 4 KiB write into a 4 MiB object
+        moves O(stripe) bytes end-to-end."""
+        osd = self.osd
+        codec = osd.codec_for(self.pool)
+        si = osd.sinfo_for(self.pool)
         k, n = codec.k, codec.get_chunk_count()
         live = {s: o for o, s in self.live_members()}
         if len(live) < k:
-            raise RuntimeError(f"pg {self.pgid}: {len(live)} < k={k} shards")
-        if data is None:
-            chunks = {j: None for j in range(n)}
-            size = 0
-        else:
-            encoded = await self.osd.ec_batcher.encode(codec, data)
-            chunks = {j: encoded[j].tobytes() for j in range(n)}
-            size = len(data)
-        waits = []
-        for j in range(n):
-            if j not in live:
-                continue  # degraded write: the hole recovers via peering
-            payload = chunks[j]
-            attrs = {}
-            if payload is not None:
-                attrs = {
-                    ATTR_SIZE: denc.enc_u64(size),
-                    ATTR_HINFO: denc.enc_u32(
-                        native.crc32c(np.frombuffer(payload, np.uint8))
-                    ),
-                }
-            target = live[j]
-            if target == self.osd.id:
-                self.osd.store.queue_transaction(
-                    self._local_txn(oid, payload, version, attrs, entry,
-                                    state=state)
+            raise RuntimeError(
+                f"pg {self.pgid}: {len(live)} < k={k} shards"
+            )
+        version = entry.version
+
+        if st8.deleted:
+            await self._ec_fanout(oid, entry, {
+                codec.chunk_index(g): tx.Transaction().remove(
+                    self._shard_cid(codec.chunk_index(g)), oid
                 )
+                for g in range(n)
+            }, hpatch=b"", ncells=0, size=0, live=live)
+            return
+
+        if st8.full_replace:
+            # cls rebuilt the object: degenerate overlay = full rewrite
+            ov = st.Overlay(st8.size0 if st8.exists0 else 0)
+            ov.truncate(0)
+            if st8._data:
+                ov.write(0, bytes(st8._data))
+        else:
+            ov = st8.ov
+        old_size = st8.size0 if st8.exists0 else 0
+        new_size = ov.size
+        old_nst = si.nstripes(old_size)
+        new_nst = si.nstripes(new_size)
+
+        touched: set[int] = set()
+        for off, ln in ov.written_ranges():
+            s0, s1 = si.stripe_span(off, ln)
+            touched.update(range(s0, min(s1, new_nst)))
+        if new_size < old_size and new_size % si.width and new_nst:
+            # the cut stripe's pad tail must re-encode as zeros
+            touched.add(new_nst - 1)
+
+        # old stripe data needed where the overlay doesn't fully cover
+        need_old = sorted(
+            s for s in touched
+            if s * si.width < old_size and not ov.covers(
+                s * si.width,
+                min((s + 1) * si.width, new_size) - s * si.width,
+            )
+        )
+        old_parts: dict[int, bytes] = {}
+        run_start = None
+        runs: list[tuple[int, int]] = []
+        for s in need_old:
+            if run_start is None:
+                run_start, prev = s, s
+            elif s == prev + 1:
+                prev = s
+            else:
+                runs.append((run_start, prev + 1))
+                run_start, prev = s, s
+        if run_start is not None:
+            runs.append((run_start, prev + 1))
+        for a, b in runs:
+            start = a * si.width
+            end = min(b * si.width, old_size)
+            data, _sz = await self._read_ec(oid, start, end - start)
+            for s in range(a, b):
+                lo = s * si.width - start
+                old_parts[s] = data[lo : lo + si.width]
+
+        tlist = sorted(touched)
+        cells = np.zeros((len(tlist), k, si.su), dtype=np.uint8)
+        for i, s in enumerate(tlist):
+            start = s * si.width
+            end = min(start + si.width, new_size)
+            buf = ov.apply_range(start, end, old_parts.get(s, b""))
+            arr = np.frombuffer(buf, dtype=np.uint8)
+            cells[i].reshape(-1)[: arr.size] = arr
+        if tlist:
+            parity = await osd.ec_batcher.encode_cells(codec, cells)
+            all_cells = np.concatenate([cells, parity], axis=1)
+        else:
+            all_cells = np.zeros((0, n, si.su), dtype=np.uint8)
+
+        zcrc = st.zero_cell_crc(si.su)
+        shard_txns: dict[int, tx.Transaction] = {}
+        hpatches: dict[int, bytes] = {}
+        for g in range(n):
+            pos = codec.chunk_index(g)
+            cid = self._shard_cid(pos)
+            t = tx.Transaction()
+            if st8.full_replace and st8.exists0:
+                t.rmattrs(cid, oid)
+            if not st8.exists0:
+                t.touch(cid, oid)
+            if new_nst != old_nst:
+                # shrink drops cells; grow zero-fills (parity of zero
+                # data is zero for these linear codes, so zero cells
+                # are already consistent codewords)
+                t.truncate(cid, oid, new_nst * si.su)
+            patch = np.zeros((len(tlist), 2), dtype="<u4")
+            w_start = None
+            w_cells: list[bytes] = []
+            for i, s in enumerate(tlist):
+                cell = all_cells[i, g]
+                if not cell.any():
+                    crc = zcrc
+                    # zero cell: covered by truncate zero-fill when the
+                    # file grew past it; otherwise must be written
+                    skip = s >= old_nst
+                else:
+                    crc = si.crc_of_cell(cell)
+                    skip = False
+                patch[i] = (s, crc)
+                if skip:
+                    if w_start is not None:
+                        t.write(cid, oid, w_start * si.su,
+                                b"".join(w_cells))
+                        w_start, w_cells = None, []
+                    continue
+                if w_start is None or s != w_start + len(w_cells):
+                    if w_start is not None:
+                        t.write(cid, oid, w_start * si.su,
+                                b"".join(w_cells))
+                    w_start, w_cells = s, []
+                w_cells.append(cell.tobytes())
+            if w_start is not None:
+                t.write(cid, oid, w_start * si.su, b"".join(w_cells))
+            for m_ in st8.xattr_muts:
+                if m_[0] == "set":
+                    t.setattr(cid, oid, USER_ATTR + m_[1], m_[2])
+                else:
+                    t.rmattr(cid, oid, USER_ATTR + m_[1])
+            if st8.full_replace:
+                for xk, xv in st8.xattrs().items():
+                    t.setattr(cid, oid, USER_ATTR + xk, xv)
+            shard_txns[pos] = t
+            hpatches[pos] = patch.tobytes()
+        await self._ec_fanout(oid, entry, shard_txns, hpatch=hpatches,
+                              ncells=new_nst, size=new_size, live=live)
+
+    async def _ec_fanout(self, oid: bytes, entry: Entry,
+                         shard_txns: dict[int, tx.Transaction],
+                         hpatch, ncells: int, size: int,
+                         live: dict[int, int]) -> None:
+        """Apply the local shard's transaction and fan sub-writes out to
+        the other shards; ack when every live shard commits."""
+        osd = self.osd
+        version = entry.version
+        waits = []
+        for pos, t in shard_txns.items():
+            target = live.get(pos)
+            if target is None:
+                continue  # degraded write: the hole recovers via peering
+            hp = hpatch[pos] if isinstance(hpatch, dict) else hpatch
+            if target == osd.id:
+                self._apply_shard_write(self._shard_cid(pos), t, entry,
+                                        hp, ncells, size, version)
                 continue
-            cid = f"{self.pgid[0]}.{self.pgid[1]}s{j}"
-            rt = self._remote_txn(cid, oid, payload, version, attrs,
-                                  state=state)
+            subtid = osd.new_subtid()
+            fut = osd.expect_reply(subtid)
+            waits.append((target, subtid, fut))
+            await osd.send(
+                f"osd.{target}",
+                M.MECSubWrite(tid=subtid, pgid=self.pgid, shard=pos,
+                              txn=t.encode(), entry=entry.encode(),
+                              epoch=osd.osdmap.epoch, hpatch=hp,
+                              ncells=ncells, size=size,
+                              trace=_trace_ctx()),
+            )
+        await osd.gather(waits)
+
+    def _apply_shard_write(self, cid: str, t: tx.Transaction,
+                           entry: Entry, hpatch: bytes, ncells: int,
+                           size: int, version) -> None:
+        """Shard-side apply of one EC sub-write (primary's own shard and
+        handle_ec_write share it): run the mutation ops, patch the
+        per-cell CRC attr (hash_info role) and size/version attrs,
+        persist the log — one atomic transaction."""
+        osd = self.osd
+        full = tx.Transaction()
+        if cid not in osd.store.list_collections():
+            full.create_collection(cid)
+        full.ops.extend(self._filter_remote_ops(t))
+        oid = entry.oid
+        removing = any(op.code == tx.OP_REMOVE and op.oid == oid
+                       for op in t.ops)
+        if not removing:
+            si = osd.sinfo_for(self.pool)
+            try:
+                old = st.dec_hinfo(osd.store.getattr(cid, oid,
+                                                     ATTR_HINFO))
+            except Exception:
+                old = np.zeros(0, dtype="<u4")
+            arr = np.full(ncells, st.zero_cell_crc(si.su), dtype="<u4")
+            ncopy = min(len(old), ncells)
+            arr[:ncopy] = old[:ncopy]
+            if hpatch:
+                pairs = np.frombuffer(hpatch, dtype="<u4").reshape(-1, 2)
+                in_range = pairs[:, 0] < ncells
+                arr[pairs[in_range, 0]] = pairs[in_range, 1]
+            full.setattrs(cid, oid, {
+                ATTR_HINFO: st.enc_hinfo(arr),
+                ATTR_SIZE: denc.enc_u64(size),
+                ATTR_V: enc_ver(version),
+            })
+        if entry.version > self.log.head:
+            self.log.append(entry)
+            self.log.trim(osd.log_keep)
+        self._persist_log(full)
+        osd.store.queue_transaction(full)
+
+    async def _ec_remote_meta(self, oid: bytes):
+        """(size, user-attrs) of an EC object from any peer shard, or
+        None when absent everywhere (metadata-only sub-reads, length=0,
+        issued concurrently). Used when the primary's own shard lacks
+        the object (hole being backfilled)."""
+        waits = []
+        for pos, target in sorted(
+            (s, o) for o, s in self.live_members() if o != self.osd.id
+        ):
             subtid = self.osd.new_subtid()
             fut = self.osd.expect_reply(subtid)
             waits.append((target, subtid, fut))
             await self.osd.send(
                 f"osd.{target}",
-                M.MECSubWrite(tid=subtid, pgid=self.pgid, shard=j,
-                              txn=rt.encode(), entry=entry.encode(),
-                              epoch=self.osd.osdmap.epoch,
-                              trace=_trace_ctx()),
+                M.MECSubRead(tid=subtid, pgid=self.pgid, shard=pos,
+                             oid=oid, offset=0, length=0,
+                             trace=_trace_ctx()),
             )
-        await self.osd.gather(waits)
+        found = None
+        for target, subtid, fut in waits:
+            reply = await self.osd.await_reply(subtid, fut, target)
+            if reply.result == M.OK and found is None:
+                found = (reply.size, reply.attrs)
+        return found
 
-    # -------------------------------------------------------------- reads
-
-    async def _op_read(self, oid: bytes) -> tuple[bytes, int]:
-        if not self.is_ec:
-            data = self.osd.store.read(self.cid, oid)
-            return bytes(data), len(data)
-        return await self._read_ec(oid)
-
-    async def _read_ec(self, oid: bytes) -> tuple[bytes, int]:
-        """Gather k chunks (degraded: any k, then decode) and concat.
+    async def _read_ec(self, oid: bytes, offset: int = 0,
+                       length: int = -1) -> tuple[bytes, int]:
+        """Bytes of [offset, offset+length) (clamped to the object) and
+        the object size — fetching only the cells of the touched
+        stripes from k shards.
 
         The objects_read_and_reconstruct role (ECBackend.cc:2405):
         minimum_to_decode picks the fetch set from available shards,
-        sub-reads verify hinfo CRCs, decode rebuilds missing data
-        chunks. A failed sub-read (EIO, hinfo mismatch, lost chunk)
+        sub-reads verify per-cell hinfo CRCs, decode rebuilds missing
+        data cells. A failed sub-read (EIO, hinfo mismatch, lost chunk)
         excludes that shard and re-plans the fetch set from survivors —
         the reconstruct-on-read arc of test-erasure-eio.sh."""
-        codec = self.osd.codec_for(self.pool)
+        osd = self.osd
+        codec = osd.codec_for(self.pool)
+        si = osd.sinfo_for(self.pool)
         k = codec.k
         live = {s: o for o, s in self.live_members()}
-        want = list(range(k))
+        size = None
+        try:
+            size = denc.dec_u64(
+                osd.store.getattr(self.cid, oid, ATTR_SIZE), 0
+            )[0]
+        except Exception:
+            pass
+        if size is not None:
+            end = size if length < 0 else min(offset + length, size)
+            if end <= offset:
+                return b"", size
+            s0, s1 = si.stripe_span(offset, end - offset)
+            coff, clen = s0 * si.su, (s1 - s0) * si.su
+        else:
+            # size unknown (no local shard): fetch whole shard files
+            s0, coff, clen = 0, 0, -1
+        want = [codec.chunk_index(i) for i in range(k)]
         chunks: dict[int, bytes] = {}
         failed: set[int] = set()
         enoent = 0
-        size = None
         while True:
             usable = [s for s in sorted(live) if s not in failed]
             try:
@@ -663,34 +1047,38 @@ class PG:
                     continue
                 target = live[j]
                 if target == self.osd.id:
-                    cid = f"{self.pgid[0]}.{self.pgid[1]}s{j}"
+                    cid = self._shard_cid(j)
                     try:
-                        if self.osd.fault.hit("ec_local_read", oid=oid,
-                                              shard=j):
+                        if osd.fault.hit("ec_local_read", oid=oid,
+                                         shard=j):
                             raise IOError("injected local EIO")
-                        chunk = bytes(self.osd.store.read(cid, oid))
-                        self._verify_hinfo(cid, oid, chunk)
+                        chunk = bytes(osd.store.read(cid, oid, coff,
+                                                     clen))
+                        self._verify_hinfo(cid, oid, chunk,
+                                           first_cell=s0)
                         chunks[j] = chunk
-                        size = denc.dec_u64(
-                            self.osd.store.getattr(cid, oid, ATTR_SIZE), 0
-                        )[0]
+                        if size is None:
+                            size = denc.dec_u64(
+                                osd.store.getattr(cid, oid, ATTR_SIZE),
+                                0,
+                            )[0]
                     except NotFound:
                         enoent += 1
                         failed.add(j)
                     except IOError:
                         failed.add(j)
                     continue
-                subtid = self.osd.new_subtid()
-                fut = self.osd.expect_reply(subtid)
+                subtid = osd.new_subtid()
+                fut = osd.expect_reply(subtid)
                 waits.append((j, target, subtid, fut))
-                await self.osd.send(
+                await osd.send(
                     f"osd.{target}",
                     M.MECSubRead(tid=subtid, pgid=self.pgid, shard=j,
-                                 oid=oid, offset=0, length=-1,
+                                 oid=oid, offset=coff, length=clen,
                                  trace=_trace_ctx()),
                 )
             for j, target, subtid, fut in waits:
-                reply = await self.osd.await_reply(subtid, fut, target)
+                reply = await osd.await_reply(subtid, fut, target)
                 if reply.result == M.OK:
                     chunks[j] = reply.data
                     if size is None:
@@ -703,20 +1091,59 @@ class PG:
                 break
         if size is None:
             raise KeyError(oid)
-        decoded = codec.decode(want, chunks)
-        data = b"".join(decoded[j].tobytes() for j in want)
-        return data[:size], size
+        if clen == -1:
+            # size learned late: the whole-file fetch covers everything
+            end = size if length < 0 else min(offset + length, size)
+            if end <= offset:
+                return b"", size
+        # equalize lengths defensively (lagging shards), then decode
+        want_missing = [p for p in want if p not in chunks]
+        if want_missing:
+            maxlen = max(len(c) for c in chunks.values())
+            arrs = {
+                p: np.frombuffer(
+                    c.ljust(maxlen, b"\0"), dtype=np.uint8
+                )
+                for p, c in chunks.items()
+            }
+            decoded = codec.decode(want, arrs)
+        else:
+            decoded = {
+                p: np.frombuffer(chunks[p], dtype=np.uint8)
+                for p in want
+            }
+        # cells -> logical bytes: (ncells, k, su), stripe-major
+        ncells_r = max(len(decoded[p]) for p in want) // si.su
+        stack = np.zeros((k, ncells_r * si.su), dtype=np.uint8)
+        for i in range(k):
+            d = decoded[codec.chunk_index(i)]
+            stack[i, : d.size] = d
+        logical = np.ascontiguousarray(
+            stack.reshape(k, ncells_r, si.su).transpose(1, 0, 2)
+        ).reshape(-1)
+        lo = offset - s0 * si.width
+        return bytes(logical[lo : lo + (end - offset)]), size
 
-    def _verify_hinfo(self, cid: str, oid: bytes, chunk: bytes) -> None:
-        stored = denc.dec_u32(
-            self.osd.store.getattr(cid, oid, ATTR_HINFO), 0
-        )[0]
-        actual = native.crc32c(np.frombuffer(chunk, np.uint8))
-        if stored != actual:
-            raise IOError(
-                f"hinfo mismatch on {cid}/{oid!r}: {stored:#x} != "
-                f"{actual:#x}"
-            )
+    def _verify_hinfo(self, cid: str, oid: bytes, chunk: bytes,
+                      first_cell: int = 0) -> None:
+        """Per-cell CRC verification of a shard-file range starting at
+        cell ``first_cell`` (hash_info role, per-cell so partial
+        overwrites never re-hash the whole shard)."""
+        if not chunk:
+            return
+        si = self.osd.sinfo_for(self.pool)
+        stored = st.dec_hinfo(
+            self.osd.store.getattr(cid, oid, ATTR_HINFO)
+        )
+        cells = np.frombuffer(chunk, dtype=np.uint8).reshape(-1, si.su)
+        for idx in range(len(cells)):
+            actual = native.crc32c(np.ascontiguousarray(cells[idx]))
+            if stored[first_cell + idx] != actual:
+                raise IOError(
+                    f"hinfo mismatch on {cid}/{oid!r} cell "
+                    f"{first_cell + idx}: {stored[first_cell + idx]:#x}"
+                    f" != {actual:#x}"
+                )
 
     # ================================================== sub-op handlers ==
 
@@ -742,15 +1169,8 @@ class PG:
     async def handle_ec_write(self, src: str, m: M.MECSubWrite) -> None:
         t, _ = tx.Transaction.decode(m.txn)
         entry, _ = Entry.decode(m.entry)
-        full = tx.Transaction()
-        if self.cid not in self.osd.store.list_collections():
-            full.create_collection(self.cid)
-        full.ops.extend(self._filter_remote_ops(t))
-        if entry.version > self.log.head:
-            self.log.append(entry)
-            self.log.trim(self.osd.log_keep)
-        self._persist_log(full)
-        self.osd.store.queue_transaction(full)
+        self._apply_shard_write(self.cid, t, entry, m.hpatch, m.ncells,
+                                m.size, entry.version)
         self.osd.perf.inc("subop_w")
         await self.osd.send(
             src,
@@ -771,15 +1191,25 @@ class PG:
         return ops
 
     async def handle_ec_read(self, src: str, m: M.MECSubRead) -> None:
+        """Serve a (ranged) shard read: length=-1 is the whole shard
+        file, length=0 is metadata only, else a cell-aligned byte range
+        of the shard file; covered cells verify against hinfo."""
         try:
             if self.osd.fault.hit("ec_sub_read", oid=m.oid,
                                   osd=self.osd.id, shard=m.shard):
                 raise IOError("injected EIO")
-            chunk = bytes(self.osd.store.read(self.cid, m.oid))
-            self._verify_hinfo(self.cid, m.oid, chunk)
-            digest = denc.dec_u32(
-                self.osd.store.getattr(self.cid, m.oid, ATTR_HINFO), 0
-            )[0]
+            if m.length == 0:
+                if not self.osd.store.exists(self.cid, m.oid):
+                    raise NotFound(repr(m.oid))
+                chunk = b""
+            else:
+                chunk = bytes(self.osd.store.read(self.cid, m.oid,
+                                                  m.offset, m.length))
+                si = self.osd.sinfo_for(self.pool)
+                self._verify_hinfo(self.cid, m.oid, chunk,
+                                   first_cell=m.offset // si.su)
+            digest = native.crc32c(np.frombuffer(chunk, np.uint8)) \
+                if chunk else 0
             size = denc.dec_u64(
                 self.osd.store.getattr(self.cid, m.oid, ATTR_SIZE), 0
             )[0]
@@ -1053,13 +1483,21 @@ class PG:
                 continue  # re-plan with the enlarged failed set
         if size_attr is None:
             size_attr = denc.enc_u64(remote_size or 0)
-        decoded = codec.decode([shard], chunks)
+        maxlen = max(len(c) for c in chunks.values()) if chunks else 0
+        arrs = {
+            p: np.frombuffer(c.ljust(maxlen, b"\0"), dtype=np.uint8)
+            for p, c in chunks.items()
+        }
+        decoded = codec.decode([shard], arrs)
         chunk = decoded[shard].tobytes()
+        si = self.osd.sinfo_for(self.pool)
         return chunk, {
             **user_attrs,
             ATTR_SIZE: size_attr,
-            ATTR_HINFO: denc.enc_u32(
-                native.crc32c(np.frombuffer(chunk, np.uint8))
+            ATTR_HINFO: st.enc_hinfo(
+                st.StripeInfo.cell_crcs(
+                    np.frombuffer(chunk, np.uint8), si.su
+                )
             ),
         }
 
@@ -1113,14 +1551,17 @@ class PG:
         for oid, (size, crc) in digests.items():
             objects[oid] = (self._object_version(oid), (size, crc))
             if self.is_ec:
+                # self-verify every cell against the stored per-cell
+                # hinfo (bit-rot detection)
                 try:
-                    stored = denc.dec_u32(
-                        self.osd.store.getattr(self.cid, oid, ATTR_HINFO), 0
-                    )[0]
-                except Exception:
-                    stored = None
-                if stored is not None and stored != crc:
+                    self._verify_hinfo(
+                        self.cid, oid,
+                        bytes(self.osd.store.read(self.cid, oid)),
+                    )
+                except IOError:
                     errors.append(oid)
+                except Exception:
+                    pass  # no hinfo attr (e.g. meta-only objects)
         return objects, errors
 
     async def handle_scrub(self, src: str, m: M.MScrub) -> None:

@@ -73,6 +73,11 @@ class RSCodec(ErasureCode):
     DEFAULT_TECHNIQUE = "reed_sol_van"
     W = 8
 
+    #: GF(2^8) matrix codes act independently on every byte position, so
+    #: any slicing of chunks (cells, ranges) encodes/decodes identically
+    #: to the whole — the property the stripe-RMW data path relies on.
+    bytewise_linear = True
+
     def init(self, profile) -> None:
         super().init(profile)
         self.technique = self.profile.get(

@@ -1,0 +1,130 @@
+"""Stripe arithmetic + overlay semantics (ECUtil.h:27 stripe_info_t role)."""
+import numpy as np
+import pytest
+
+from ceph_tpu.cluster import stripe as st
+from ceph_tpu.ec.registry import load_codec
+
+
+def test_stripe_spans_and_sizes():
+    si = st.StripeInfo(k=3, m=2, stripe_unit=4096)
+    assert si.width == 12288
+    assert si.nstripes(0) == 0
+    assert si.nstripes(1) == 1
+    assert si.nstripes(12288) == 1
+    assert si.nstripes(12289) == 2
+    assert si.shard_size(12289) == 8192
+    assert si.stripe_span(0, 1) == (0, 1)
+    assert si.stripe_span(12287, 2) == (0, 2)
+    assert si.stripe_span(12288, 1) == (1, 2)
+    assert si.stripe_span(0, 0) == (0, 0)
+
+
+def test_effective_stripe_unit_rs():
+    codec = load_codec({"plugin": "rs_tpu", "k": "3", "m": "2"})
+    assert st.effective_stripe_unit(codec, 4096) == 4096
+    # odd request rounds up to codec alignment
+    su = st.effective_stripe_unit(codec, 1000)
+    assert su >= 1000 and codec.get_chunk_size(codec.k * su) == su
+
+
+def test_cells_roundtrip():
+    si = st.StripeInfo(k=2, m=1, stripe_unit=8)
+    data = np.arange(40, dtype=np.uint8)  # 2.5 stripes
+    cells = si.to_cells(data, 0, 3)
+    assert cells.shape == (3, 2, 8)
+    flat = si.from_cells(cells)
+    assert bytes(flat[:40]) == bytes(data)
+    assert not flat[40:].any()  # zero padding
+
+
+def _shadow(ops, old=b""):
+    """Reference model: apply the same ops to a plain bytearray."""
+    data = bytearray(old)
+    for op, *args in ops:
+        if op == "write":
+            off, payload = args
+            end = off + len(payload)
+            if len(data) < end:
+                data.extend(b"\0" * (end - len(data)))
+            data[off:end] = payload
+        elif op == "zero":
+            off, ln = args
+            end = off + ln
+            if len(data) < end:
+                data.extend(b"\0" * (end - len(data)))
+            data[off:end] = b"\0" * ln
+        elif op == "truncate":
+            (size,) = args
+            if size < len(data):
+                del data[size:]
+            else:
+                data.extend(b"\0" * (size - len(data)))
+    return data
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_overlay_matches_shadow_model(seed):
+    rng = np.random.default_rng(seed)
+    old = bytes(rng.integers(0, 256, 3000, dtype=np.uint8))
+    ops = []
+    for _ in range(12):
+        kind = rng.choice(["write", "zero", "truncate"])
+        if kind == "write":
+            off = int(rng.integers(0, 4000))
+            ln = int(rng.integers(1, 600))
+            ops.append(("write", off,
+                        bytes(rng.integers(0, 256, ln, dtype=np.uint8))))
+        elif kind == "zero":
+            ops.append(("zero", int(rng.integers(0, 4000)),
+                        int(rng.integers(1, 600))))
+        else:
+            ops.append(("truncate", int(rng.integers(0, 4500))))
+    ov = st.Overlay(len(old))
+    for op, *args in ops:
+        getattr(ov, op)(*args)
+    assert bytes(ov.apply(old)) == bytes(_shadow(ops, old))
+    assert ov.size == len(_shadow(ops, old))
+
+
+def test_overlay_covers_and_slice():
+    ov = st.Overlay(100)
+    ov.write(10, b"a" * 20)
+    ov.write(30, b"b" * 10)
+    assert ov.covers(10, 30)
+    assert ov.covers(15, 20)
+    assert not ov.covers(5, 10)
+    assert not ov.covers(35, 10)
+    assert ov.slice(25, 10) == b"a" * 5 + b"b" * 5
+    ov.zero(40, 5)
+    assert ov.slice(38, 5) == b"bb\0\0\0"
+
+
+def test_overlay_truncate_drops_extents():
+    ov = st.Overlay(50)
+    ov.write(10, b"x" * 30)  # [10, 40)
+    ov.truncate(20)
+    assert ov.size == 20
+    assert ov.written_ranges() == [(10, 10)]
+    assert ov.truncated
+    ov.truncate(60)  # extend: explicit zero extent
+    assert ov.written_ranges() == [(10, 10), (20, 40)]
+    assert bytes(ov.apply(b"o" * 50)) == (
+        b"o" * 10 + b"x" * 10 + b"\0" * 40
+    )
+
+
+def test_overlay_empty():
+    ov = st.Overlay(77)
+    assert ov.empty
+    ov.write(0, b"z")
+    assert not ov.empty
+
+
+def test_hinfo_roundtrip_and_zero_cell():
+    crcs = np.array([1, 2, 0xDEADBEEF], dtype=np.uint32)
+    assert (st.dec_hinfo(st.enc_hinfo(crcs)) == crcs).all()
+    su = 512
+    assert st.zero_cell_crc(su) == st.StripeInfo(1, 0, su).crc_of_cell(
+        np.zeros(su, dtype=np.uint8)
+    )
