@@ -37,6 +37,7 @@ class RadosClient:
         self._ops: dict[int, _InFlight] = {}
         self._pools: dict[str, int] = {}
         self._map_waiters: list[asyncio.Future] = []
+        self._snap_ops: dict[int, asyncio.Future] = {}
         self._watches: dict[tuple[bytes, int], object] = {}
         self._next_cookie = 0
         self._tracer = trace.get_tracer(name)
@@ -73,6 +74,10 @@ class RadosClient:
             for fut in self._map_waiters:
                 if not fut.done():
                     fut.set_result(None)
+        elif isinstance(msg, M.MPoolSnapReply):
+            fut = self._snap_ops.get(msg.tid)
+            if fut is not None and not fut.done():
+                fut.set_result(msg)
 
     def _apply_map(self, msg: M.MOSDMapMsg) -> None:
         if msg.full:
@@ -169,17 +174,24 @@ class RadosClient:
                 pass
             await asyncio.sleep(0.05)
 
-    async def _submit_pg(self, pgid, oid: bytes,
-                         ops: list[tuple]) -> M.MOSDOpReply:
+    async def _submit_pg(self, pgid, oid: bytes, ops: list[tuple],
+                         snapc=None, snapid=None) -> M.MOSDOpReply:
         """Track + send one op vector to a PG's primary and await the
-        reply (shared by object ops and PG-level ops like pgls)."""
+        reply (shared by object ops and PG-level ops like pgls).
+        ``snapc`` is a write SnapContext (seq, [snaps desc]); ``snapid``
+        the snap a read resolves at (None = head)."""
+        from .snaps import NOSNAP
+
         self._tid += 1
         verb = ops[0][0] if ops else "noop"
+        seq, snap_list = snapc if snapc else (0, [])
         with self._tracer.start_span(verb) as span:
             span.tag("pgid", pgid).tag("oid",
                                        oid[:64].decode(errors="replace"))
             msg = M.MOSDOp(tid=self._tid, pgid=pgid, oid=oid, ops=ops,
-                           epoch=self.osdmap.epoch, trace=span.ctx)
+                           epoch=self.osdmap.epoch, trace=span.ctx,
+                           snap_seq=seq, snaps=list(snap_list),
+                           snapid=NOSNAP if snapid is None else snapid)
             op = _InFlight(msg=msg, fut=asyncio.get_running_loop()
                            .create_future())
             self._ops[self._tid] = op
@@ -192,12 +204,14 @@ class RadosClient:
         return reply
 
     async def _submit(self, pool_id: int, name: str | bytes,
-                      ops: list[tuple]) -> M.MOSDOpReply:
+                      ops: list[tuple], snapc=None,
+                      snapid=None) -> M.MOSDOpReply:
         if self.osdmap is None or pool_id not in self.osdmap.pools:
             await self._wait_pool(pool_id)
         oid = name.encode() if isinstance(name, str) else bytes(name)
         pgid = self.osdmap.object_to_pg(pool_id, oid)
-        reply = await self._submit_pg(pgid, oid, ops)
+        reply = await self._submit_pg(pgid, oid, ops, snapc=snapc,
+                                      snapid=snapid)
         if reply.result != M.OK:
             if reply.result == M.ENOENT:
                 raise KeyError(name)
@@ -242,48 +256,108 @@ class RadosClient:
         await asyncio.wait_for(fut, self.op_timeout)
         return self._pools.get("_last", pool.id)
 
-    async def write_full(self, pool_id: int, name, data: bytes) -> None:
+    async def write_full(self, pool_id: int, name, data: bytes,
+                         snapc=None) -> None:
         await self._submit(pool_id, name,
-                           [M.osd_op("writefull", data=bytes(data))])
+                           [M.osd_op("writefull", data=bytes(data))],
+                           snapc=snapc)
 
     async def write(self, pool_id: int, name, offset: int,
-                    data: bytes) -> None:
+                    data: bytes, snapc=None) -> None:
         await self._submit(
             pool_id, name,
             [M.osd_op("write", offset=offset, data=bytes(data))],
+            snapc=snapc,
         )
 
-    async def append(self, pool_id: int, name, data: bytes) -> None:
+    async def append(self, pool_id: int, name, data: bytes,
+                     snapc=None) -> None:
         await self._submit(pool_id, name,
-                           [M.osd_op("append", data=bytes(data))])
+                           [M.osd_op("append", data=bytes(data))],
+                           snapc=snapc)
 
-    async def truncate(self, pool_id: int, name, size: int) -> None:
+    async def truncate(self, pool_id: int, name, size: int,
+                       snapc=None) -> None:
         await self._submit(pool_id, name,
-                           [M.osd_op("truncate", offset=size)])
+                           [M.osd_op("truncate", offset=size)],
+                           snapc=snapc)
 
     async def zero(self, pool_id: int, name, offset: int,
-                   length: int) -> None:
+                   length: int, snapc=None) -> None:
         await self._submit(
             pool_id, name,
             [M.osd_op("zero", offset=offset, length=length)],
+            snapc=snapc,
         )
 
     async def read(self, pool_id: int, name, offset: int = 0,
-                   length: int = -1) -> bytes:
+                   length: int = -1, snapid=None) -> bytes:
         reply = await self._submit(
             pool_id, name,
             [M.osd_op("read", offset=offset, length=length)],
+            snapid=snapid,
         )
         return reply.outs[0][1]
 
-    async def stat(self, pool_id: int, name) -> int:
-        reply = await self._submit(pool_id, name, [M.osd_op("stat")])
+    async def stat(self, pool_id: int, name, snapid=None) -> int:
+        reply = await self._submit(pool_id, name, [M.osd_op("stat")],
+                                   snapid=snapid)
         from ..utils import denc
 
         return denc.dec_u64(reply.outs[0][1], 0)[0]
 
-    async def delete(self, pool_id: int, name) -> None:
-        await self._submit(pool_id, name, [M.osd_op("delete")])
+    async def delete(self, pool_id: int, name, snapc=None) -> None:
+        await self._submit(pool_id, name, [M.osd_op("delete")],
+                           snapc=snapc)
+
+    # ------------------------------------------------- selfmanaged snaps
+
+    async def selfmanaged_snap_create(self, pool_id: int) -> int:
+        """Allocate a new snap id from the mon (bumps pool snap_seq;
+        the librados selfmanaged_snap_create role). The caller owns the
+        SnapContext it builds from returned ids."""
+        reply = await self._pool_snap_op(pool_id, "create", 0)
+        return reply.snapid
+
+    async def selfmanaged_snap_remove(self, pool_id: int,
+                                      snapid: int) -> None:
+        """Mark a snap removed; OSDs trim clone data for it on the next
+        map epoch (librados selfmanaged_snap_remove role)."""
+        await self._pool_snap_op(pool_id, "remove", snapid)
+
+    async def _pool_snap_op(self, pool_id: int, op: str,
+                            snapid: int) -> "M.MPoolSnapReply":
+        self._tid += 1
+        tid = self._tid
+        fut = asyncio.get_running_loop().create_future()
+        self._snap_ops[tid] = fut
+        try:
+            await self.bus.send(
+                self.name, "mon",
+                M.MPoolSnapOp(pool_id=pool_id, op=op, snapid=snapid,
+                              tid=tid),
+            )
+            reply = await asyncio.wait_for(fut, self.op_timeout)
+        finally:
+            self._snap_ops.pop(tid, None)
+        if reply.result != M.OK:
+            raise IOError(f"pool snap op {op} failed: {reply.result}")
+        # wait until our map reflects the epoch (so subsequent writes
+        # carry a SnapContext the OSDs consider current)
+        deadline = asyncio.get_running_loop().time() + self.op_timeout
+        while self.osdmap is None or self.osdmap.epoch < reply.epoch:
+            if asyncio.get_running_loop().time() > deadline:
+                break
+            try:
+                await self.bus.send(
+                    self.name, "mon",
+                    M.MMonGetMap(
+                        have=self.osdmap.epoch if self.osdmap else 0),
+                )
+            except Exception:
+                pass
+            await asyncio.sleep(0.02)
+        return reply
 
     async def getxattr(self, pool_id: int, name, key: str) -> bytes:
         reply = await self._submit(

@@ -75,6 +75,25 @@ class MPoolCreateReply(Message):
     FIELDS = (("pool_id", "i32"), ("epoch", "u32"))
 
 
+@register_message
+class MPoolSnapOp(Message):
+    TYPE = 18
+    # op: "create" allocates a new snap id (bumps pool snap_seq),
+    # "remove" marks [snapid, snapid+1) removed (drives OSD trimming) —
+    # the OSDMonitor selfmanaged-snap verbs
+    FIELDS = (("pool_id", "i32"), ("op", "str"), ("snapid", "u64"),
+              ("tid", "u64"))
+    DEFAULTS = {"snapid": 0, "tid": 0}
+
+
+@register_message
+class MPoolSnapReply(Message):
+    TYPE = 19
+    FIELDS = (("pool_id", "i32"), ("snapid", "u64"), ("result", "i32"),
+              ("epoch", "u32"), ("tid", "u64"))
+    DEFAULTS = {"tid": 0}
+
+
 # ---------------------------------------------------------- client <-> osd
 
 
@@ -155,9 +174,16 @@ class MOSDOp(Message):
         ("oid", "bytes"),
         ("ops", (_enc_osd_ops, _dec_osd_ops)),
         ("epoch", "u32"),  # client's map epoch at send time
+        # SnapContext for writes (seq + existing snap ids, descending;
+        # the selfmanaged_snap_set_write_ctx role) and the snap id reads
+        # resolve at (CEPH_NOSNAP = head)
+        ("snap_seq", "u64"),
+        ("snaps", "list:u64"),
+        ("snapid", "u64"),
         ("trace", "pair:u64:u64"),  # span ctx (utils/trace; 0,0 = off)
     )
-    DEFAULTS = {"trace": (0, 0)}
+    DEFAULTS = {"trace": (0, 0), "snap_seq": 0, "snaps": [],
+                "snapid": 2**64 - 2}
 
 
 @register_message

@@ -103,6 +103,8 @@ class MonLite:
             await self._handle_failure(msg)
         elif isinstance(msg, M.MPoolCreate):
             await self._handle_pool_create(src, msg)
+        elif isinstance(msg, M.MPoolSnapOp):
+            await self._handle_pool_snap(src, msg)
         elif isinstance(msg, M.MConfigSet):
             await self._handle_config_set(msg)
         elif isinstance(msg, M.MUpmapItems):
@@ -151,6 +153,51 @@ class MonLite:
         await self.bus.send(
             self.name, src,
             M.MPoolCreateReply(pool_id=pool.id, epoch=self.osdmap.epoch),
+        )
+
+    async def _handle_pool_snap(self, src: str, msg: M.MPoolSnapOp) -> None:
+        """Selfmanaged snap allocation / removal (OSDMonitor snap verbs):
+        'create' bumps pool snap_seq and returns the new id; 'remove'
+        unions [snapid, snapid+1) into removed_snaps — OSDs trim on the
+        resulting map epoch."""
+        import copy
+
+        from . import snaps as sn
+
+        pool = self.osdmap.pools.get(msg.pool_id)
+        if pool is None:
+            await self.bus.send(
+                self.name, src,
+                M.MPoolSnapReply(pool_id=msg.pool_id, snapid=0,
+                                 result=M.ENOENT,
+                                 epoch=self.osdmap.epoch, tid=msg.tid),
+            )
+            return
+        pool = copy.deepcopy(pool)
+        if msg.op == "create":
+            pool.snap_seq += 1
+            snapid = pool.snap_seq
+        elif msg.op == "remove":
+            snapid = msg.snapid
+            pool.removed_snaps = sn.interval_insert(
+                pool.removed_snaps, snapid, snapid + 1
+            )
+        else:
+            await self.bus.send(
+                self.name, src,
+                M.MPoolSnapReply(pool_id=msg.pool_id, snapid=0,
+                                 result=-22, epoch=self.osdmap.epoch,
+                                 tid=msg.tid),
+            )
+            return
+        inc = self._new_inc()
+        inc.new_pools.append(pool)
+        await self.commit(inc)
+        await self.bus.send(
+            self.name, src,
+            M.MPoolSnapReply(pool_id=msg.pool_id, snapid=snapid,
+                             result=M.OK, epoch=self.osdmap.epoch,
+                             tid=msg.tid),
         )
 
     # -------------------------------------------------------------- config

@@ -141,6 +141,8 @@ class OSDLite:
         self._subtid = 0
         self._codecs: dict[int, object] = {}
         self._sinfos: dict[int, object] = {}
+        #: pool id -> removed_snaps intervals already trimmed by this OSD
+        self._trimmed_snaps: dict[int, list[tuple[int, int]]] = {}
         self._hb_task: asyncio.Task | None = None
         self._worker_task: asyncio.Task | None = None
         self._tasks: set[asyncio.Task] = set()
@@ -159,6 +161,7 @@ class OSDLite:
         p.add_histogram("ec_batch_stripes", "stripes per EC batch")
         p.add_u64_counter("recovery_pushes", "objects pushed to peers")
         p.add_u64_counter("scrubs", "scrub rounds executed")
+        p.add_u64_counter("snap_trims", "objects snap-trimmed")
         p.add_u64_counter("map_epochs", "osdmap epochs consumed")
 
     # ----------------------------------------------------------- plumbing
@@ -570,6 +573,70 @@ class OSDLite:
             # down in a new map)
             await self.bus.send(self.name, "mon", M.MOSDBoot(osd=self.id))
         self._scan_pgs()
+        self._kick_snap_trim()
+
+    def _kick_snap_trim(self) -> None:
+        """Launch trimming for snap ids newly marked removed in the map
+        (the SnapTrimmer arc: pool removed_snaps delta -> per-PG trim).
+        An interval is recorded as processed only after every local
+        primary PG trims it successfully — a failed or pre-failover
+        attempt retries on the next map change or PG activation."""
+        from . import snaps as sn_mod
+
+        if self.osdmap is None:
+            return
+        for pool in self.osdmap.pools.values():
+            seen = self._trimmed_snaps.get(pool.id, [])
+            new_ids = sn_mod.interval_diff_ids(pool.removed_snaps, seen)
+            if not new_ids:
+                continue
+            prim = [pg for key, pg in list(self.pgs.items())
+                    if key[0] == pool.id and pg.is_primary()]
+            if not prim:
+                continue  # not our PGs to trim; do NOT mark processed
+            snapshot = [tuple(iv) for iv in pool.removed_snaps]
+            self.spawn(self._trim_pool(pool.id, snapshot, prim, new_ids))
+
+    async def _trim_pool(self, pool_id: int, intervals, pgs,
+                         snapids: list[int]) -> None:
+        ok = True
+        for pg in pgs:
+            if not await self._trim_pg(pg, snapids):
+                ok = False
+        if ok:
+            self._trimmed_snaps[pool_id] = intervals
+
+    async def _trim_pg(self, pg: PG, snapids: list[int]) -> bool:
+        # wait for activity (a trim racing peering retries next tick)
+        for _ in range(100):
+            if pg.state == "active" or not pg.is_primary():
+                break
+            await asyncio.sleep(0.05)
+        if not pg.is_primary():
+            return True  # no longer our job; the new primary trims
+        if pg.state != "active":
+            return False
+        try:
+            n = await pg.trim_snaps(snapids)
+            if n:
+                self.perf.inc("snap_trims", n)
+            return True
+        except Exception:
+            self.log_exc(f"pg {pg.pgid} snap trim")
+            return False
+
+    def kick_pg_snap_trim(self, pg: PG) -> None:
+        """On PG activation (incl. primary failover): re-run trimming
+        for every removed snap of its pool — idempotent, and the only
+        way a NEW primary learns about removals it never processed."""
+        from . import snaps as sn_mod
+
+        if self.osdmap is None or pg.pgid[0] not in self.osdmap.pools:
+            return
+        pool = self.osdmap.pools[pg.pgid[0]]
+        ids = sn_mod.interval_diff_ids(pool.removed_snaps, [])
+        if ids:
+            self.spawn(self._trim_pg(pg, ids))
 
     def _scan_pgs(self) -> None:
         """Instantiate/refresh PGs this OSD hosts under the current map

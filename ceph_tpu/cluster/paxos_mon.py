@@ -295,7 +295,8 @@ class PaxosMon(MonLite):
             # a peon that later wins an election keeps serving the DB
             self.config_db = {(w, k): v for w, k, v in msg.entries}
         elif isinstance(msg, (M.MOSDBoot, M.MFailure, M.MPoolCreate,
-                              M.MConfigSet, M.MUpmapItems)):
+                              M.MPoolSnapOp, M.MConfigSet,
+                              M.MUpmapItems)):
             # map-mutating requests: a peon forwards to the leader
             # (Monitor::forward_request_leader role); commits that race
             # a leadership change fail quietly and the requester retries

@@ -37,6 +37,7 @@ from ..store import transaction as tx
 from ..utils import denc
 from ..utils import trace as tr
 from . import messages as M
+from . import snaps as sn
 from . import stripe as st
 from .pglog import OP_DELETE, OP_MODIFY, ZERO, Entry, PGInfo, PGLog
 
@@ -55,8 +56,19 @@ META_OID = b"_pgmeta"
 ATTR_V = "v"
 ATTR_SIZE = "size"
 ATTR_HINFO = "hinfo"
+ATTR_SS = "ss"  # head SnapSet (the SS_ATTR role)
+ATTR_WHITEOUT = "wh"  # deleted head kept for its clones (snapdir role)
 USER_ATTR = "u:"  # user xattr namespace within store attrs
 OMAP_HDR = "_oh"
+
+
+def enc_entries(entries: list[Entry]) -> bytes:
+    return denc.enc_list(entries, lambda e: e.encode())
+
+
+def dec_entries(buf: bytes) -> list[Entry]:
+    out, _ = denc.dec_list(buf, 0, Entry.decode)
+    return out
 
 #: op-vector verbs that mutate (the CEPH_OSD_OP write-class role)
 WRITE_OPS = frozenset((
@@ -115,6 +127,12 @@ class _OpState:
         self.full_replace = False
         self.mutated = False
         self.deleted = False
+        #: system-attr updates (SnapSet, whiteout) keyed by attr name
+        self.sys_attrs: dict[str, bytes] = {}
+        #: pending lazy clone: (clone oid, clone version)
+        self.clone_req: tuple[bytes, tuple[int, int]] | None = None
+        self.whiteout_delete = False
+        self.was_whiteout = False
 
     async def init(self) -> None:
         pg, oid = self.pg, self.oid
@@ -138,6 +156,16 @@ class _OpState:
                 self.size0 = store.stat(pg.cid, oid)
                 self.exists0 = True
             except NotFound:
+                pass
+        if self.exists0:
+            try:
+                store.getattr(pg.cid, oid, ATTR_WHITEOUT)
+                # a deleted head kept only for its clones: invisible to
+                # the op vector (reads ENOENT, writes re-create)
+                self.exists0 = False
+                self.size0 = 0
+                self.was_whiteout = True
+            except Exception:
                 pass
         self.ov = st.Overlay(self.size0 if self.exists0 else 0)
 
@@ -373,8 +401,10 @@ class PG:
         t.truncate(self.cid, META_OID, 0)
         t.write(self.cid, META_OID, 0, enc)
 
-    def _append_and_persist(self, entry: Entry, t: tx.Transaction) -> None:
-        self.log.append(entry)
+    def _append_and_persist(self, entries: list[Entry],
+                            t: tx.Transaction) -> None:
+        for entry in entries:
+            self.log.append(entry)
         self.log.trim(self.osd.log_keep)
         self._persist_log(t)
 
@@ -453,7 +483,11 @@ class PG:
                 objs = self.osd.store.list_objects(self.cid)
             except NotFound:  # no write ever landed: empty PG
                 objs = []
-            oids = sorted(o for o in objs if o != META_OID)
+            oids = sorted(
+                o for o in objs
+                if o != META_OID and not sn.is_clone_oid(o)
+                and not self._is_whiteout(o)
+            )
             out = denc.enc_list(oids, denc.enc_bytes)
             await self.osd.send(
                 src,
@@ -467,14 +501,16 @@ class PG:
                           for o in m.ops)
         perf.inc("op_w" if write_class else "op_r")
         t0 = time.perf_counter()
+        snapc = (m.snap_seq, list(m.snaps))
         try:
             if write_class:
                 async with self.lock:
-                    outs, size = await self._execute_ops(m.oid, m.ops,
-                                                         src=src)
+                    outs, size = await self._execute_ops(
+                        m.oid, m.ops, src=src, snapc=snapc,
+                        snapid=m.snapid)
             else:
-                outs, size = await self._execute_ops(m.oid, m.ops,
-                                                     src=src)
+                outs, size = await self._execute_ops(
+                    m.oid, m.ops, src=src, snapc=snapc, snapid=m.snapid)
             first = next((d for r, d in outs if d), b"")
             reply = M.MOSDOpReply(tid=m.tid, result=M.OK, data=first,
                                   size=size, outs=outs,
@@ -497,14 +533,28 @@ class PG:
 
     # ------------------------------------------------- op-vector engine
 
-    async def _execute_ops(self, oid: bytes, ops,
-                           src: str = "") -> tuple[list, int]:
+    async def _execute_ops(self, oid: bytes, ops, src: str = "",
+                           snapc=(0, ()), snapid=sn.NOSNAP,
+                           ) -> tuple[list, int]:
         """Apply the op vector against a lazy working state of the
         object (do_osd_ops role): reads inside the vector see earlier
         writes, mutations commit atomically at the end, any failure
         aborts the whole vector. Data mutations accumulate as an
-        overlay so the backends ship deltas, not the object. Returns
-        ([(result, data)] per op, object size)."""
+        overlay so the backends ship deltas, not the object.
+
+        ``snapc`` (seq, snaps) triggers lazy clone-on-write
+        (make_writeable role, PrimaryLogPG.cc:8526); ``snapid`` != NOSNAP
+        resolves reads against the head's SnapSet
+        (find_object_context role). Returns ([(result, data)], size)."""
+        if snapid != sn.NOSNAP:
+            if any(o[0] in WRITE_OPS or o[0] == "call" for o in ops):
+                raise OpError(-22, "write to a snap")  # EINVAL
+            ss = self._load_snapset(oid) or sn.SnapSet()
+            which = ss.resolve(snapid)
+            if which is None:
+                raise OpError(M.ENOENT)
+            if which != sn.NOSNAP:
+                oid = sn.clone_oid(oid, which)
         st8 = _OpState(self, oid)
         await st8.init()
         outs: list[tuple[int, bytes]] = []
@@ -632,15 +682,73 @@ class PG:
                 raise OpError(EOPNOTSUPP, f"op {op!r}")
             outs.append((M.OK, out))
         if st8.mutated:
-            version = self.next_version()
+            entries = self._prepare_snap_clone(oid, st8, snapc)
+            epoch = self.osd.osdmap.epoch
+            seq = self.log.head[1] + 1 + len(entries)
             prior = self._object_version(oid)
             op_kind = OP_DELETE if st8.deleted else OP_MODIFY
-            entry = Entry(op_kind, oid, version, prior)
+            entries.append(Entry(op_kind, oid, (epoch, seq), prior))
             if self.is_ec:
-                await self._write_ec_rmw(oid, st8, entry)
+                await self._write_ec_rmw(oid, st8, entries)
             else:
-                await self._write_replicated(oid, st8, entry)
+                await self._write_replicated(oid, st8, entries)
         return outs, st8.size if not st8.deleted else 0
+
+    def _prepare_snap_clone(self, oid: bytes, st8: _OpState,
+                            snapc) -> list[Entry]:
+        """make_writeable role (PrimaryLogPG.cc:8526): when the write's
+        SnapContext is newer than the head's SnapSet, preserve the
+        pre-write head as a clone object (store-level COW) and record
+        which snap ids it serves. Also resolves delete-vs-clones into a
+        whiteout. Returns log entries for any clone created."""
+        snap_seq, snap_ids = snapc
+        # filter the writer's SnapContext through the pool's removed
+        # snaps (PrimaryLogPG filter_snapc role): a stale client must
+        # not resurrect clones for snaps already deleted
+        removed = self.pool.removed_snaps
+        if removed:
+            snap_ids = [s for s in snap_ids
+                        if not sn.interval_contains(removed, s)]
+        ss = self._load_snapset(oid)
+        entries: list[Entry] = []
+        epoch = self.osd.osdmap.epoch
+        if snap_seq:
+            cur_seq = ss.seq if ss else 0
+            if snap_seq > cur_seq:
+                new_snaps = sorted(
+                    (s for s in snap_ids if s > cur_seq), reverse=True
+                )
+                if ss is None:
+                    ss = sn.SnapSet()
+                if st8.exists0 and new_snaps:
+                    coid = sn.clone_oid(oid, snap_seq)
+                    ss.clones.append(
+                        sn.Clone(snap_seq, new_snaps, st8.size0)
+                    )
+                    cv = (epoch, self.log.head[1] + 1)
+                    st8.clone_req = (coid, cv)
+                    entries.append(Entry(OP_MODIFY, coid, cv, ZERO))
+                ss.seq = snap_seq
+                st8.sys_attrs[ATTR_SS] = ss.encode()
+        if st8.deleted and ss is not None and ss.clones:
+            # head has live clones: keep it as a whiteout (snapdir role)
+            st8.whiteout_delete = True
+            st8.sys_attrs[ATTR_SS] = ss.encode()
+        return entries
+
+    def _load_snapset(self, oid: bytes) -> "sn.SnapSet | None":
+        try:
+            raw = self.osd.store.getattr(self.cid, oid, ATTR_SS)
+            return sn.SnapSet.decode(raw)[0]
+        except Exception:
+            return None
+
+    def _is_whiteout(self, oid: bytes) -> bool:
+        try:
+            self.osd.store.getattr(self.cid, oid, ATTR_WHITEOUT)
+            return True
+        except Exception:
+            return False
 
     @staticmethod
     def _check_exists(exists0: bool, mutated: bool) -> None:
@@ -666,15 +774,31 @@ class PG:
         (the ReplicatedBackend.cc:465 role: the transaction, never the
         object). The primary applies the identical ops locally."""
         t = tx.Transaction()
+        if st8.clone_req is not None:
+            # lazy clone of the pre-write head (make_writeable role):
+            # store-level COW before any mutation lands
+            coid, cv = st8.clone_req
+            t.clone(cid, oid, coid)
+            t.setattr(cid, coid, ATTR_V, enc_ver(cv))
         if st8.deleted:
-            t.remove(cid, oid)
+            if st8.whiteout_delete:
+                t.truncate(cid, oid, 0)
+                t.rmattrs(cid, oid)
+                t.omap_clear(cid, oid)
+                t.omap_setheader(cid, oid, b"")
+                t.setattr(cid, oid, ATTR_WHITEOUT, b"1")
+                for name, val in st8.sys_attrs.items():
+                    t.setattr(cid, oid, name, val)
+                t.setattr(cid, oid, ATTR_V, enc_ver(version))
+            else:
+                t.remove(cid, oid)
             return t
         if st8.full_replace:
             # a cls method rebuilt arbitrary facets: replace everything
             t.truncate(cid, oid, 0)
             t.write(cid, oid, 0, bytes(st8._data))
             t.rmattrs(cid, oid)
-            attrs = {ATTR_V: enc_ver(version)}
+            attrs = {ATTR_V: enc_ver(version), **st8.sys_attrs}
             for k, v in st8.xattrs().items():
                 attrs[USER_ATTR + k] = v
             t.setattrs(cid, oid, attrs)
@@ -712,20 +836,29 @@ class PG:
             elif kind == "clear":
                 t.omap_clear(cid, oid)
                 t.omap_setheader(cid, oid, b"")
+        if st8.was_whiteout:
+            t.rmattr(cid, oid, ATTR_WHITEOUT)
+        for name, val in st8.sys_attrs.items():
+            t.setattr(cid, oid, name, val)
         t.setattr(cid, oid, ATTR_V, enc_ver(version))
         return t
 
     async def _write_replicated(self, oid: bytes, st8: _OpState,
-                                entry: Entry) -> None:
-        version = entry.version
+                                entries: list[Entry]) -> None:
+        version = entries[-1].version
+        mut = self._rep_mutation_txn(self.cid, oid, st8, version)
+        await self._rep_fanout(mut, entries)
+
+    async def _rep_fanout(self, mut: tx.Transaction,
+                          entries: list[Entry]) -> None:
+        """Apply a mutation transaction locally (primary orders), fan it
+        out to replicas, ack on all-commit."""
         peers = [(o, s) for o, s in self.live_members()
                  if o != self.osd.id]
-        mut = self._rep_mutation_txn(self.cid, oid, st8, version)
-        # local apply first (primary orders), then fan out, ack on all
         local = tx.Transaction()
         self._ensure_coll(local)
         local.ops.extend(self._filter_remote_ops(mut))
-        self._append_and_persist(entry, local)
+        self._append_and_persist(entries, local)
         self.osd.store.queue_transaction(local)
         enc_txn = mut.encode()
         waits = []
@@ -736,7 +869,7 @@ class PG:
             await self.osd.send(
                 f"osd.{o}",
                 M.MOSDRepOp(tid=subtid, pgid=self.pgid, txn=enc_txn,
-                            entry=entry.encode(),
+                            entry=enc_entries(entries),
                             epoch=self.osd.osdmap.epoch,
                             trace=_trace_ctx()),
             )
@@ -748,7 +881,7 @@ class PG:
         return f"{self.pgid[0]}.{self.pgid[1]}s{pos}"
 
     async def _write_ec_rmw(self, oid: bytes, st8: _OpState,
-                            entry: Entry) -> None:
+                            entries: list[Entry]) -> None:
         """EC delta write (ECBackend.cc:1898 start_rmw role): read the
         touched stripes' old data, re-encode ONLY those stripes (one
         batched device dispatch), ship per-cell deltas + CRC patches to
@@ -764,15 +897,33 @@ class PG:
             raise RuntimeError(
                 f"pg {self.pgid}: {len(live)} < k={k} shards"
             )
-        version = entry.version
 
-        if st8.deleted:
-            await self._ec_fanout(oid, entry, {
-                codec.chunk_index(g): tx.Transaction().remove(
-                    self._shard_cid(codec.chunk_index(g)), oid
-                )
-                for g in range(n)
-            }, hpatch=b"", ncells=0, size=0, live=live)
+        if st8.deleted and not st8.whiteout_delete:
+            shard_txns = {}
+            for g in range(n):
+                pos = codec.chunk_index(g)
+                t = tx.Transaction()
+                self._ec_clone_ops(t, pos, oid, st8)
+                t.remove(self._shard_cid(pos), oid)
+                shard_txns[pos] = t
+            await self._ec_fanout(oid, entries, shard_txns, hpatch=b"",
+                                  ncells=0, size=0, live=live)
+            return
+        if st8.deleted:  # whiteout: keep head shell for its clones
+            shard_txns = {}
+            for g in range(n):
+                pos = codec.chunk_index(g)
+                cid = self._shard_cid(pos)
+                t = tx.Transaction()
+                self._ec_clone_ops(t, pos, oid, st8)
+                t.truncate(cid, oid, 0)
+                t.rmattrs(cid, oid)
+                t.setattr(cid, oid, ATTR_WHITEOUT, b"1")
+                for name, val in st8.sys_attrs.items():
+                    t.setattr(cid, oid, name, val)
+                shard_txns[pos] = t
+            await self._ec_fanout(oid, entries, shard_txns, hpatch=b"",
+                                  ncells=0, size=0, live=live)
             return
 
         if st8.full_replace:
@@ -846,6 +997,7 @@ class PG:
             pos = codec.chunk_index(g)
             cid = self._shard_cid(pos)
             t = tx.Transaction()
+            self._ec_clone_ops(t, pos, oid, st8)
             if st8.full_replace and st8.exists0:
                 t.rmattrs(cid, oid)
             if not st8.exists0:
@@ -891,19 +1043,34 @@ class PG:
             if st8.full_replace:
                 for xk, xv in st8.xattrs().items():
                     t.setattr(cid, oid, USER_ATTR + xk, xv)
+            if st8.was_whiteout:
+                t.rmattr(cid, oid, ATTR_WHITEOUT)
+            for name, val in st8.sys_attrs.items():
+                t.setattr(cid, oid, name, val)
             shard_txns[pos] = t
             hpatches[pos] = patch.tobytes()
-        await self._ec_fanout(oid, entry, shard_txns, hpatch=hpatches,
+        await self._ec_fanout(oid, entries, shard_txns, hpatch=hpatches,
                               ncells=new_nst, size=new_size, live=live)
 
-    async def _ec_fanout(self, oid: bytes, entry: Entry,
+    def _ec_clone_ops(self, t: tx.Transaction, pos: int, oid: bytes,
+                      st8: _OpState) -> None:
+        """Per-shard lazy clone (make_writeable role): clone the shard
+        file — data, hinfo, size, user attrs ride along."""
+        if st8.clone_req is None:
+            return
+        cid = self._shard_cid(pos)
+        coid, cv = st8.clone_req
+        t.clone(cid, oid, coid)
+        t.setattr(cid, coid, ATTR_V, enc_ver(cv))
+
+    async def _ec_fanout(self, oid: bytes, entries: list[Entry],
                          shard_txns: dict[int, tx.Transaction],
                          hpatch, ncells: int, size: int,
                          live: dict[int, int]) -> None:
         """Apply the local shard's transaction and fan sub-writes out to
         the other shards; ack when every live shard commits."""
         osd = self.osd
-        version = entry.version
+        version = entries[-1].version
         waits = []
         for pos, t in shard_txns.items():
             target = live.get(pos)
@@ -911,8 +1078,9 @@ class PG:
                 continue  # degraded write: the hole recovers via peering
             hp = hpatch[pos] if isinstance(hpatch, dict) else hpatch
             if target == osd.id:
-                self._apply_shard_write(self._shard_cid(pos), t, entry,
-                                        hp, ncells, size, version)
+                self._apply_shard_write(self._shard_cid(pos), t,
+                                        entries, hp, ncells, size,
+                                        version)
                 continue
             subtid = osd.new_subtid()
             fut = osd.expect_reply(subtid)
@@ -920,7 +1088,7 @@ class PG:
             await osd.send(
                 f"osd.{target}",
                 M.MECSubWrite(tid=subtid, pgid=self.pgid, shard=pos,
-                              txn=t.encode(), entry=entry.encode(),
+                              txn=t.encode(), entry=enc_entries(entries),
                               epoch=osd.osdmap.epoch, hpatch=hp,
                               ncells=ncells, size=size,
                               trace=_trace_ctx()),
@@ -928,18 +1096,19 @@ class PG:
         await osd.gather(waits)
 
     def _apply_shard_write(self, cid: str, t: tx.Transaction,
-                           entry: Entry, hpatch: bytes, ncells: int,
-                           size: int, version) -> None:
+                           entries: list[Entry], hpatch: bytes,
+                           ncells: int, size: int, version) -> None:
         """Shard-side apply of one EC sub-write (primary's own shard and
         handle_ec_write share it): run the mutation ops, patch the
-        per-cell CRC attr (hash_info role) and size/version attrs,
-        persist the log — one atomic transaction."""
+        per-cell CRC attr (hash_info role) and size/version attrs —
+        targeting the LAST entry's object, the mutated head — and
+        persist the log, one atomic transaction."""
         osd = self.osd
         full = tx.Transaction()
         if cid not in osd.store.list_collections():
             full.create_collection(cid)
         full.ops.extend(self._filter_remote_ops(t))
-        oid = entry.oid
+        oid = entries[-1].oid
         removing = any(op.code == tx.OP_REMOVE and op.oid == oid
                        for op in t.ops)
         if not removing:
@@ -961,9 +1130,10 @@ class PG:
                 ATTR_SIZE: denc.enc_u64(size),
                 ATTR_V: enc_ver(version),
             })
-        if entry.version > self.log.head:
-            self.log.append(entry)
-            self.log.trim(osd.log_keep)
+        for entry in entries:
+            if entry.version > self.log.head:
+                self.log.append(entry)
+        self.log.trim(osd.log_keep)
         self._persist_log(full)
         osd.store.queue_transaction(full)
 
@@ -1149,14 +1319,15 @@ class PG:
 
     async def handle_rep_op(self, src: str, m: M.MOSDRepOp) -> None:
         t, _ = tx.Transaction.decode(m.txn)
-        entry, _ = Entry.decode(m.entry)
+        entries = dec_entries(m.entry)
         full = tx.Transaction()
         if self.cid not in self.osd.store.list_collections():
             full.create_collection(self.cid)
         full.ops.extend(self._filter_remote_ops(t))
-        if entry.version > self.log.head:
-            self.log.append(entry)
-            self.log.trim(self.osd.log_keep)
+        for entry in entries:
+            if entry.version > self.log.head:
+                self.log.append(entry)
+        self.log.trim(self.osd.log_keep)
         self._persist_log(full)
         self.osd.store.queue_transaction(full)
         self.osd.perf.inc("subop_w")
@@ -1168,9 +1339,9 @@ class PG:
 
     async def handle_ec_write(self, src: str, m: M.MECSubWrite) -> None:
         t, _ = tx.Transaction.decode(m.txn)
-        entry, _ = Entry.decode(m.entry)
-        self._apply_shard_write(self.cid, t, entry, m.hpatch, m.ncells,
-                                m.size, entry.version)
+        entries = dec_entries(m.entry)
+        self._apply_shard_write(self.cid, t, entries, m.hpatch, m.ncells,
+                                m.size, entries[-1].version)
         self.osd.perf.inc("subop_w")
         await self.osd.send(
             src,
@@ -1179,13 +1350,24 @@ class PG:
         )
 
     def _filter_remote_ops(self, t: tx.Transaction) -> list:
-        """Drop remove ops for objects we do not hold (delete of a never-
-        recovered object on a revived shard must not fail the txn)."""
+        """Drop ops that cannot apply on a diverged member: removes of
+        objects we do not hold, and clones whose source is missing (a
+        revived replica pending recovery must still ack the txn; the
+        skipped objects converge via recovery/scrub). Ops targeting a
+        skipped clone are dropped with it so no empty shell appears."""
         ops = []
+        skipped_dests: set[tuple[str, bytes]] = set()
         for op in t.ops:
             if op.code == tx.OP_REMOVE and not self.osd.store.exists(
                 op.cid, op.oid
             ):
+                continue
+            if op.code == tx.OP_CLONE and not self.osd.store.exists(
+                op.cid, op.oid
+            ):
+                skipped_dests.add((op.cid, op.args["dest"]))
+                continue
+            if (op.cid, op.oid) in skipped_dests:
                 continue
             ops.append(op)
         return ops
@@ -1315,6 +1497,7 @@ class PG:
         if osd.osdmap.epoch != epoch:
             return False
         self.state = "active"
+        osd.kick_pg_snap_trim(self)  # new primary: catch up on removals
         waiting, self.waiting = self.waiting, []
         for src, m in waiting:
             osd.spawn(self.do_op(src, m))
@@ -1675,6 +1858,97 @@ class PG:
                 )
             repaired.append((o, s))
         return repaired
+
+    # ===================================================== snap trimming ==
+
+    async def trim_snaps(self, snapids: list[int]) -> int:
+        """Remove trimmed snap ids from every clone's preserved set and
+        delete clones (and whiteout heads) left covering nothing — the
+        SnapTrimmer role, driven by pool removed_snaps deltas. Primary
+        only; mutations replicate through the normal write fanout so
+        every member trims in lockstep. Returns objects touched."""
+        if not self.is_primary() or self.state != "active" or not snapids:
+            return 0
+        store = self.osd.store
+        if self.cid not in store.list_collections():
+            return 0
+        touched = 0
+        for oid in list(store.list_objects(self.cid)):
+            if oid == META_OID or sn.is_clone_oid(oid):
+                continue
+            async with self.lock:
+                # SnapSet must load under the PG lock: a racing client
+                # write can add a clone between load and commit
+                ss = self._load_snapset(oid)
+                if ss is None or not ss.clones:
+                    continue
+                removed_clones: list[int] = []
+                changed = False
+                for c in list(ss.clones):
+                    kept = [s for s in c.snaps if s not in snapids]
+                    if len(kept) != len(c.snaps):
+                        changed = True
+                        c.snaps = kept
+                        if not kept:
+                            ss.clones.remove(c)
+                            removed_clones.append(c.cloneid)
+                if not changed:
+                    continue
+                await self._commit_trim(oid, ss, removed_clones)
+            touched += 1
+        return touched
+
+    async def _commit_trim(self, oid: bytes, ss: "sn.SnapSet",
+                           removed_clones: list[int]) -> None:
+        osd = self.osd
+        epoch = osd.osdmap.epoch
+        kill_head = self._is_whiteout(oid) and not ss.clones
+        entries: list[Entry] = []
+        seq = self.log.head[1]
+        for cloneid in removed_clones:
+            seq += 1
+            entries.append(Entry(OP_DELETE, sn.clone_oid(oid, cloneid),
+                                 (epoch, seq), ZERO))
+        seq += 1
+        entries.append(Entry(
+            OP_DELETE if kill_head else OP_MODIFY, oid, (epoch, seq),
+            self._object_version(oid),
+        ))
+        version = entries[-1].version
+        if not self.is_ec:
+            t = tx.Transaction()
+            for cloneid in removed_clones:
+                t.remove(self.cid, sn.clone_oid(oid, cloneid))
+            if kill_head:
+                t.remove(self.cid, oid)
+            else:
+                t.setattr(self.cid, oid, ATTR_SS, ss.encode())
+                t.setattr(self.cid, oid, ATTR_V, enc_ver(version))
+            await self._rep_fanout(t, entries)
+            return
+        codec = osd.codec_for(self.pool)
+        si = osd.sinfo_for(self.pool)
+        live = {s: o for o, s in self.live_members()}
+        try:
+            size = denc.dec_u64(
+                osd.store.getattr(self.cid, oid, ATTR_SIZE), 0)[0]
+        except Exception:
+            size = 0
+        shard_txns: dict[int, tx.Transaction] = {}
+        for g in range(codec.get_chunk_count()):
+            pos = codec.chunk_index(g)
+            cid = self._shard_cid(pos)
+            t = tx.Transaction()
+            for cloneid in removed_clones:
+                t.remove(cid, sn.clone_oid(oid, cloneid))
+            if kill_head:
+                t.remove(cid, oid)
+            else:
+                t.setattr(cid, oid, ATTR_SS, ss.encode())
+            shard_txns[pos] = t
+        await self._ec_fanout(oid, entries, shard_txns, hpatch=b"",
+                              ncells=si.nstripes(size), size=size,
+                              live=live)
 
     # ---------------------------------------------- peering-side handlers
 

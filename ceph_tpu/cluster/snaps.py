@@ -63,21 +63,45 @@ class SnapSet:
 
     def resolve(self, snapid: int) -> int | None:
         """Which clone serves a read at ``snapid``? Returns the cloneid,
-        or NOSNAP when the head covers it (snapid newer than every
-        clone), or None when no copy covers that snap (the object was
-        created after it, or the clone range skips it).
+        or NOSNAP when the head covers it (snapid newer than seq), or
+        None when no copy covers that snap (the object was created
+        after it, or the snap was trimmed from every clone).
 
-        A clone named C covers the snap range (prev_cloneid, C] — the
-        find-first-clone->=snap walk of PrimaryLogPG::find_object_context.
-        """
+        The find-first-clone->=snap walk of
+        PrimaryLogPG::find_object_context, including its membership
+        check: the snap must be in the clone's exact preserved set —
+        reads at snaps predating the object, or trimmed out of the
+        covering clone, report does-not-exist."""
         if snapid == NOSNAP:
             return NOSNAP
-        prev = 0
         for c in self.clones:
             if c.cloneid >= snapid:
-                return c.cloneid if snapid > prev else None
-            prev = c.cloneid
-        return NOSNAP  # newer than all clones: head serves it
+                return c.cloneid if snapid in c.snaps else None
+        # newer than all clones: the head serves it only if it is also
+        # newer than the last clone point; otherwise that history is gone
+        return NOSNAP if snapid > self.seq else None
+
+
+# ----------------------------------------------------------- clone oids
+
+#: reserved oid prefix for clone objects (the hobject_t snap-field role:
+#: clones live beside the head in the same collection, under a prefix no
+#: client-facing listing returns)
+CLONE_PREFIX = b"\x00s"
+
+
+def clone_oid(oid: bytes, cloneid: int) -> bytes:
+    return CLONE_PREFIX + cloneid.to_bytes(8, "big") + b"\x00" + oid
+
+
+def is_clone_oid(oid: bytes) -> bool:
+    return oid.startswith(CLONE_PREFIX)
+
+
+def parse_clone_oid(coid: bytes) -> tuple[bytes, int]:
+    """-> (head oid, cloneid)."""
+    cloneid = int.from_bytes(coid[2:10], "big")
+    return coid[11:], cloneid
 
 
 # ------------------------------------------------------- interval sets

@@ -146,6 +146,11 @@ def _enc_pool(p: Pool) -> bytes:
             denc.enc_str(p.type),
             denc.enc_u32(p.pgp_num),
             denc.enc_map(p.ec_profile, denc.enc_str, denc.enc_str),
+            denc.enc_u64(p.snap_seq),
+            denc.enc_list(
+                p.removed_snaps,
+                lambda iv: denc.enc_u64(iv[0]) + denc.enc_u64(iv[1]),
+            ),
         )
     )
 
@@ -160,9 +165,18 @@ def _dec_pool(buf, off):
     ptype, off = denc.dec_str(buf, off)
     pgp, off = denc.dec_u32(buf, off)
     prof, off = denc.dec_map(buf, off, denc.dec_str, denc.dec_str)
+    snap_seq, off = denc.dec_u64(buf, off)
+
+    def _iv(b, o):
+        lo, o = denc.dec_u64(b, o)
+        hi, o = denc.dec_u64(b, o)
+        return (lo, hi), o
+
+    removed, off = denc.dec_list(buf, off, _iv)
     return (
         Pool(id=pid, name=name, size=size, min_size=min_size, pg_num=pg_num,
-             crush_rule=rule, type=ptype, pgp_num=pgp, ec_profile=prof),
+             crush_rule=rule, type=ptype, pgp_num=pgp, ec_profile=prof,
+             snap_seq=snap_seq, removed_snaps=removed),
         off,
     )
 

@@ -92,6 +92,11 @@ class Pool:
     type: str = "replicated"  # or "erasure"
     pgp_num: int = 0
     ec_profile: dict[str, str] = field(default_factory=dict)
+    #: selfmanaged-snapshot allocation state (pg_pool_t snap_seq /
+    #: removed_snaps roles): ids are allocated by the mon, removal is an
+    #: interval set that drives OSD-side snap trimming
+    snap_seq: int = 0
+    removed_snaps: list[tuple[int, int]] = field(default_factory=list)
 
     def __post_init__(self):
         if self.pgp_num == 0:

@@ -36,8 +36,24 @@ class ImageExists(Exception):
 
 ATTR_SIZE = "rbd.size"
 ATTR_LAYOUT = "rbd.layout"
-ATTR_SNAPS = "rbd.snaps"
+ATTR_SNAPS = "rbd.snaps"  # list of (name, RADOS selfmanaged snap id)
+ATTR_SNAPSEQ = "rbd.snapseq"  # image SnapContext seq (monotone)
 ATTR_PARENT = "rbd.parent"  # "name@snap" of the clone source
+
+
+def _enc_snaps(pairs: list[tuple[str, int]]) -> bytes:
+    return denc.enc_list(
+        pairs, lambda p: denc.enc_str(p[0]) + denc.enc_u64(p[1])
+    )
+
+
+def _dec_snaps(raw: bytes) -> list[tuple[str, int]]:
+    def one(b, o):
+        nm, o = denc.dec_str(b, o)
+        sid, o = denc.dec_u64(b, o)
+        return (nm, sid), o
+
+    return denc.dec_list(raw, 0, one)[0]
 
 DEFAULT_LAYOUT = FileLayout(stripe_unit=1 << 22, stripe_count=1,
                             object_size=1 << 22)
@@ -68,7 +84,8 @@ class RBD:
               .create()
               .setxattr(ATTR_SIZE, denc.enc_u64(size))
               .setxattr(ATTR_LAYOUT, _enc_layout(layout))
-              .setxattr(ATTR_SNAPS, denc.enc_list([], denc.enc_str)))
+              .setxattr(ATTR_SNAPS, _enc_snaps([]))
+              .setxattr(ATTR_SNAPSEQ, denc.enc_u64(0)))
         try:
             await self.client.operate(self.pool_id, _header(name), op)
         except IOError as e:
@@ -133,9 +150,19 @@ class Image:
         self.size = 0
         self.layout = DEFAULT_LAYOUT
         self.snaps: list[str] = []
+        self.snap_ids: dict[str, int] = {}
+        self.snap_seq = 0
         self.parent: tuple[str, str] | None = None
+        self._parent_snapid: int | None = None
 
     # ------------------------------------------------------------- meta
+
+    def _snapc(self) -> tuple[int, list[int]]:
+        """The image's write SnapContext: data-object writes carry it so
+        RADOS makes lazy clones (librbd sits on selfmanaged snaps —
+        ImageCtx::snapc role)."""
+        return (self.snap_seq,
+                sorted(self.snap_ids.values(), reverse=True))
 
     async def refresh(self) -> None:
         try:
@@ -146,15 +173,30 @@ class Image:
             raise ImageNotFound(self.name) from None
         self.size = denc.dec_u64(attrs[ATTR_SIZE], 0)[0]
         self.layout = _dec_layout(attrs[ATTR_LAYOUT])
-        self.snaps = denc.dec_list(attrs[ATTR_SNAPS], 0, denc.dec_str)[0]
+        pairs = _dec_snaps(attrs[ATTR_SNAPS])
+        self.snaps = [nm for nm, _ in pairs]
+        self.snap_ids = dict(pairs)
+        self.snap_seq = denc.dec_u64(
+            attrs.get(ATTR_SNAPSEQ, denc.enc_u64(0)), 0)[0]
         if self.snap is not None and self.snap not in self.snaps:
             raise KeyError(f"{self.name}@{self.snap}")
         raw = attrs.get(ATTR_PARENT)
         if raw:
             pname, psnap = raw.decode().split("@", 1)
             self.parent = (pname, psnap)
+            # resolve the parent snap's RADOS id once per refresh; a
+            # vanished parent snapshot must fail loudly, not silently
+            # read the parent's live head
+            pattrs = await self.client.getxattrs(
+                self.pool_id, _header(pname))
+            pids = dict(_dec_snaps(pattrs[ATTR_SNAPS]))
+            if psnap not in pids:
+                raise ImageNotFound(
+                    f"clone source {pname}@{psnap} is gone")
+            self._parent_snapid = pids[psnap]
         else:
             self.parent = None
+            self._parent_snapid = None
 
     async def stat(self) -> dict:
         await self.refresh()
@@ -176,7 +218,8 @@ class Image:
                 oid = self._oid(new_size // lo.object_size)
                 try:
                     await self.client.truncate(
-                        self.pool_id, oid, new_size % lo.object_size
+                        self.pool_id, oid, new_size % lo.object_size,
+                        snapc=self._snapc(),
                     )
                 except KeyError:
                     pass
@@ -215,14 +258,14 @@ class Image:
                 pos += ln
             await self._copy_up(ex.objectno)
             await self.client.write(self.pool_id, ex.oid, ex.offset,
-                                    bytes(piece))
+                                    bytes(piece), snapc=self._snapc())
 
         await asyncio.gather(*(put(ex) for ex in extents))
 
     async def _copy_up(self, objectno: int) -> None:
         """Clone COW: first write to an object absent in the child
-        copies the parent snapshot's object up (librbd CopyupRequest
-        role)."""
+        copies the parent's data (read at the parent's RADOS snap id)
+        up into the child (librbd CopyupRequest role)."""
         if self.parent is None:
             return
         try:
@@ -230,21 +273,23 @@ class Image:
             return  # child already owns this object
         except KeyError:
             pass
-        pname, psnap = self.parent
-        src = _data_fmt(pname, psnap).format(objectno=objectno).encode()
+        pname, _psnap = self.parent
+        src = _data_fmt(pname).format(objectno=objectno).encode()
         try:
-            blob = await self.client.read(self.pool_id, src)
+            blob = await self.client.read(self.pool_id, src,
+                                          snapid=self._parent_snapid)
         except KeyError:
             return  # parent hole: child object starts empty
         await self.client.write_full(
-            self.pool_id, self._oid(objectno), blob
+            self.pool_id, self._oid(objectno), blob,
+            snapc=self._snapc(),
         )
 
     async def read(self, offset: int, length: int) -> bytes:
         length = max(0, min(length, self.size - offset))
         if length == 0:
             return b""
-        fmt = _data_fmt(self.name, self.snap)
+        fmt = _data_fmt(self.name)
         extents = file_to_extents(self.layout, offset, length, fmt)
         result = StripedReadResult(length)
 
@@ -256,20 +301,21 @@ class Image:
         return result.assemble()
 
     async def _read_object(self, ex) -> bytes:
+        snapid = self.snap_ids.get(self.snap) if self.snap else None
         try:
             return await self.client.read(
-                self.pool_id, ex.oid, offset=ex.offset, length=ex.length
+                self.pool_id, ex.oid, offset=ex.offset,
+                length=ex.length, snapid=snapid,
             )
         except KeyError:
             pass
         if self.snap is None and self.parent is not None:
-            pname, psnap = self.parent
-            src = _data_fmt(pname, psnap).format(
-                objectno=ex.objectno
-            ).encode()
+            pname, _psnap = self.parent
+            src = _data_fmt(pname).format(objectno=ex.objectno).encode()
             try:
                 return await self.client.read(
-                    self.pool_id, src, offset=ex.offset, length=ex.length
+                    self.pool_id, src, offset=ex.offset,
+                    length=ex.length, snapid=self._parent_snapid,
                 )
             except KeyError:
                 pass
@@ -286,7 +332,7 @@ class Image:
             await self._copy_up(ex.objectno)
             try:
                 await self.client.zero(self.pool_id, ex.oid, ex.offset,
-                                       ex.length)
+                                       ex.length, snapc=self._snapc())
             except KeyError:
                 pass  # never written: already zero
 
@@ -296,66 +342,64 @@ class Image:
         lo = self.layout
         return -(-self.size // lo.object_size) if self.size else 0
 
-    async def _rm_object(self, objno: int, snap: str | None = None):
+    async def _rm_object(self, objno: int):
         try:
-            await self.client.delete(self.pool_id, self._oid(objno, snap))
+            await self.client.delete(self.pool_id, self._oid(objno),
+                                     snapc=self._snapc())
         except KeyError:
             pass
 
     async def _remove_objects(self, snap: str | None) -> None:
         await asyncio.gather(*(
-            self._rm_object(i, snap) for i in range(self._object_count())
+            self._rm_object(i) for i in range(self._object_count())
         ))
 
     # -------------------------------------------------------- snapshots
+    #
+    # Image snapshots sit directly on RADOS selfmanaged snaps
+    # (librbd's actual design): snap_create is O(1) metadata — the mon
+    # allocates an id, subsequent writes carry it in their SnapContext
+    # and the OSDs make lazy clones on first overwrite. No data moves
+    # at snapshot time; snap_remove hands reclamation to the RADOS
+    # snap trimmer.
 
     async def snap_create(self, snap: str) -> None:
         self._writable()
         await self.refresh()
         if snap in self.snaps:
             raise ImageExists(f"{self.name}@{snap}")
-
-        async def cp(objno):
-            await self._copy_up(objno)  # materialize clone data first
-            try:
-                blob = await self.client.read(self.pool_id,
-                                              self._oid(objno))
-            except KeyError:
-                return
-            await self.client.write_full(
-                self.pool_id, self._oid(objno, snap), blob
-            )
-
-        await asyncio.gather(*(cp(i) for i in range(self._object_count())))
+        snapid = await self.client.selfmanaged_snap_create(self.pool_id)
         self.snaps.append(snap)
+        self.snap_ids[snap] = snapid
+        self.snap_seq = max(self.snap_seq, snapid)
         await self._save_snaps()
 
     async def snap_remove(self, snap: str) -> None:
         await self.refresh()
         if snap not in self.snaps:
             raise KeyError(snap)
-        await asyncio.gather(*(
-            self._rm_object(i, snap) for i in range(self._object_count())
-        ))
+        snapid = self.snap_ids.pop(snap)
         self.snaps.remove(snap)
         await self._save_snaps()
+        await self.client.selfmanaged_snap_remove(self.pool_id, snapid)
 
     async def snap_rollback(self, snap: str) -> None:
         self._writable()
         await self.refresh()
         if snap not in self.snaps:
             raise KeyError(snap)
+        snapid = self.snap_ids[snap]
 
         async def rb(objno):
             try:
                 blob = await self.client.read(
-                    self.pool_id, self._oid(objno, snap)
+                    self.pool_id, self._oid(objno), snapid=snapid
                 )
             except KeyError:
                 await self._rm_object(objno)
                 return
             await self.client.write_full(self.pool_id, self._oid(objno),
-                                         blob)
+                                         blob, snapc=self._snapc())
 
         await asyncio.gather(*(rb(i) for i in range(self._object_count())))
 
@@ -364,10 +408,13 @@ class Image:
         return list(self.snaps)
 
     async def _save_snaps(self) -> None:
-        await self.client.setxattr(
-            self.pool_id, _header(self.name), ATTR_SNAPS,
-            denc.enc_list(self.snaps, denc.enc_str),
-        )
+        from ..cluster.client import ObjectOperation
+
+        pairs = [(nm, self.snap_ids[nm]) for nm in self.snaps]
+        op = (ObjectOperation()
+              .setxattr(ATTR_SNAPS, _enc_snaps(pairs))
+              .setxattr(ATTR_SNAPSEQ, denc.enc_u64(self.snap_seq)))
+        await self.client.operate(self.pool_id, _header(self.name), op)
 
     # --------------------------------------------------------- flatten
 

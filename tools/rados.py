@@ -104,13 +104,23 @@ async def cmd_mkpool(args) -> int:
     return 0
 
 
+def _parse_snapc(spec: str | None):
+    """--snapc 'seq:id,id,...' -> (seq, [ids]) write SnapContext."""
+    if not spec:
+        return None
+    seq_s, _, ids_s = spec.partition(":")
+    ids = [int(x) for x in ids_s.split(",") if x]
+    return (int(seq_s), ids)
+
+
 async def cmd_put(args) -> int:
     data = (sys.stdin.buffer.read() if args.infile == "-"
             else open(args.infile, "rb").read())
     c, pools = await cluster_up(args)
     try:
         await c.client.write_full(_pool_id(pools, args.pool),
-                                  args.obj.encode(), data)
+                                  args.obj.encode(), data,
+                                  snapc=_parse_snapc(args.snapc))
     finally:
         await c.stop()
     return 0
@@ -120,7 +130,8 @@ async def cmd_get(args) -> int:
     c, pools = await cluster_up(args)
     try:
         data = await c.client.read(_pool_id(pools, args.pool),
-                                   args.obj.encode())
+                                   args.obj.encode(),
+                                   snapid=args.snapid)
     finally:
         await c.stop()
     if args.outfile == "-":
@@ -157,6 +168,29 @@ async def cmd_ls(args) -> int:
     try:
         for oid in await c.client.list_objects(_pool_id(pools, args.pool)):
             print(oid.decode(errors="replace"))
+    finally:
+        await c.stop()
+    return 0
+
+
+async def cmd_snap_create(args) -> int:
+    c, pools = await cluster_up(args)
+    try:
+        snapid = await c.client.selfmanaged_snap_create(
+            _pool_id(pools, args.pool))
+        print(f"created snap {snapid} in pool '{args.pool}'")
+    finally:
+        await c.stop()
+    return 0
+
+
+async def cmd_snap_rm(args) -> int:
+    c, pools = await cluster_up(args)
+    try:
+        await c.client.selfmanaged_snap_remove(
+            _pool_id(pools, args.pool), args.snapid)
+        print(f"removed snap {args.snapid} from pool '{args.pool}' "
+              "(trimming is asynchronous)")
     finally:
         await c.stop()
     return 0
@@ -314,11 +348,23 @@ def main(argv=None) -> int:
 
     p = sub.add_parser("put")
     p.add_argument("pool"), p.add_argument("obj"), p.add_argument("infile")
+    p.add_argument("--snapc", default=None,
+                   help="write SnapContext 'seq:id,id,...'")
     p.set_defaults(fn=cmd_put)
 
     p = sub.add_parser("get")
     p.add_argument("pool"), p.add_argument("obj"), p.add_argument("outfile")
+    p.add_argument("--snapid", type=int, default=None,
+                   help="read at this selfmanaged snap id")
     p.set_defaults(fn=cmd_get)
+
+    p = sub.add_parser("snap-create")
+    p.add_argument("pool")
+    p.set_defaults(fn=cmd_snap_create)
+
+    p = sub.add_parser("snap-rm")
+    p.add_argument("pool"), p.add_argument("snapid", type=int)
+    p.set_defaults(fn=cmd_snap_rm)
 
     p = sub.add_parser("rm")
     p.add_argument("pool"), p.add_argument("obj")
