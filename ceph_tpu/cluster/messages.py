@@ -8,6 +8,7 @@ eversion_t (version_t dominates within an epoch).
 from __future__ import annotations
 
 from ..msg.messages import Message, register_message
+from .snaps import NOSNAP
 
 PGID = "pair:i32:u32"
 EVERSION = "pair:u32:u64"
@@ -183,7 +184,7 @@ class MOSDOp(Message):
         ("trace", "pair:u64:u64"),  # span ctx (utils/trace; 0,0 = off)
     )
     DEFAULTS = {"trace": (0, 0), "snap_seq": 0, "snaps": [],
-                "snapid": 2**64 - 2}
+                "snapid": NOSNAP}
 
 
 @register_message

@@ -52,6 +52,11 @@ class MonLite:
         self.config_db: dict[tuple[str, str], str] = {}
         self._watchdog: asyncio.Task | None = None
         self._next_pool_id = 1
+        #: serializes read-modify-commit pool mutations (snap id
+        #: allocation, pool create): each message runs in its own task,
+        #: and a Paxos commit awaits a quorum round mid-mutation —
+        #: without this two concurrent snap creates hand out one id
+        self._pool_mut_lock = asyncio.Lock()
 
     # ---------------------------------------------------------- lifecycle
 
@@ -144,12 +149,13 @@ class MonLite:
 
     async def _handle_pool_create(self, src: str, msg: M.MPoolCreate) -> None:
         pool, _ = menc._dec_pool(msg.pool, 0)
-        if pool.id < 0:
-            pool.id = self._next_pool_id
-        self._next_pool_id = max(self._next_pool_id, pool.id + 1)
-        inc = self._new_inc()
-        inc.new_pools.append(pool)
-        await self.commit(inc)
+        async with self._pool_mut_lock:
+            if pool.id < 0:
+                pool.id = self._next_pool_id
+            self._next_pool_id = max(self._next_pool_id, pool.id + 1)
+            inc = self._new_inc()
+            inc.new_pools.append(pool)
+            await self.commit(inc)
         await self.bus.send(
             self.name, src,
             M.MPoolCreateReply(pool_id=pool.id, epoch=self.osdmap.epoch),
@@ -173,16 +179,7 @@ class MonLite:
                                  epoch=self.osdmap.epoch, tid=msg.tid),
             )
             return
-        pool = copy.deepcopy(pool)
-        if msg.op == "create":
-            pool.snap_seq += 1
-            snapid = pool.snap_seq
-        elif msg.op == "remove":
-            snapid = msg.snapid
-            pool.removed_snaps = sn.interval_insert(
-                pool.removed_snaps, snapid, snapid + 1
-            )
-        else:
+        if msg.op not in ("create", "remove"):
             await self.bus.send(
                 self.name, src,
                 M.MPoolSnapReply(pool_id=msg.pool_id, snapid=0,
@@ -190,9 +187,21 @@ class MonLite:
                                  tid=msg.tid),
             )
             return
-        inc = self._new_inc()
-        inc.new_pools.append(pool)
-        await self.commit(inc)
+        async with self._pool_mut_lock:
+            # re-read under the lock: a concurrent snap op committed a
+            # newer pool while we awaited the lock
+            pool = copy.deepcopy(self.osdmap.pools[msg.pool_id])
+            if msg.op == "create":
+                pool.snap_seq += 1
+                snapid = pool.snap_seq
+            else:
+                snapid = msg.snapid
+                pool.removed_snaps = sn.interval_insert(
+                    pool.removed_snaps, snapid, snapid + 1
+                )
+            inc = self._new_inc()
+            inc.new_pools.append(pool)
+            await self.commit(inc)
         await self.bus.send(
             self.name, src,
             M.MPoolSnapReply(pool_id=msg.pool_id, snapid=snapid,

@@ -63,9 +63,8 @@ def _header(name: str) -> str:
     return f"rbd_header.{name}"
 
 
-def _data_fmt(name: str, snap: str | None = None) -> str:
-    base = f"rbd_data.{name}." + "{objectno:016x}"
-    return base + (f"@{snap}" if snap else "")
+def _data_fmt(name: str) -> str:
+    return f"rbd_data.{name}." + "{objectno:016x}"
 
 
 class RBD:
@@ -110,7 +109,7 @@ class RBD:
         img = await self.open(name)
         if img.snaps:
             raise RuntimeError(f"image {name} has snapshots")
-        await img._remove_objects(None)
+        await img._remove_objects()
         await self.client.delete(self.pool_id, _header(name))
 
     async def clone(self, parent: str, snap: str, child: str) -> None:
@@ -235,10 +234,8 @@ class Image:
         if self.snap is not None:
             raise IOError("snapshot handles are read-only")
 
-    def _oid(self, objectno: int, snap: str | None = None) -> bytes:
-        return _data_fmt(self.name, snap).format(
-            objectno=objectno
-        ).encode()
+    def _oid(self, objectno: int) -> bytes:
+        return _data_fmt(self.name).format(objectno=objectno).encode()
 
     async def write(self, offset: int, data: bytes) -> None:
         self._writable()
@@ -309,7 +306,10 @@ class Image:
             )
         except KeyError:
             pass
-        if self.snap is None and self.parent is not None:
+        if self.parent is not None:
+            # parent fallthrough applies to snap reads too: a child
+            # object absent at the snap (never copied up before it, or
+            # copied up after) held the parent's clone-time content
             pname, _psnap = self.parent
             src = _data_fmt(pname).format(objectno=ex.objectno).encode()
             try:
@@ -349,7 +349,7 @@ class Image:
         except KeyError:
             pass
 
-    async def _remove_objects(self, snap: str | None) -> None:
+    async def _remove_objects(self) -> None:
         await asyncio.gather(*(
             self._rm_object(i) for i in range(self._object_count())
         ))

@@ -135,3 +135,31 @@ def test_clone_cow_and_flatten():
         await c.stop()
 
     run(t())
+
+
+def test_clone_child_snapshot_preserves_parent_backed_data():
+    """A snapshot of a clone child must serve parent-backed extents the
+    child never copied up — at the child's snap the object's logical
+    content was the parent's clone-time data (librbd layered-snap
+    semantics)."""
+    async def t():
+        c, rbd = await make()
+        await rbd.create("base", 32768, LAYOUT)
+        base = await rbd.open("base")
+        await base.write(0, b"GOLD" * 4096)
+        await base.snap_create("gold")
+        await rbd.clone("base", "gold", "child")
+        child = await rbd.open("child")
+        await child.snap_create("cs")  # O(1): no data copied
+        # write AFTER the snap: triggers copy-up + overwrite
+        await child.write(0, b"EDIT")
+        # the snap still shows parent content, not zeros and not EDIT
+        snapv = await rbd.open("child", snap="cs")
+        assert await snapv.read(0, 8) == b"GOLDGOLD"
+        # an object never touched in the child also resolves via parent
+        assert await snapv.read(8192, 8) == b"GOLD" * 2
+        # head shows the edit
+        assert (await child.read(0, 8))[:4] == b"EDIT"
+        await c.stop()
+
+    run(t())
