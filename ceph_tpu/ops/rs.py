@@ -195,6 +195,51 @@ def _lift_bitmatrix_planar(matrix: np.ndarray) -> np.ndarray:
     return out
 
 
+def _bytes_per_dot(cols: int) -> int:
+    """How many of a word's 4 bytes one MXU pass handles.
+
+    The GF bit-matrix contraction is only 8*C deep (<=64 for k=8) and
+    8*R tall (24 for m=3) — a fraction of the 128x128 systolic array, so
+    a one-byte-per-dot kernel is issue-bound at <10% MXU utilization
+    (measured: it pins the r2 headline at ~57 GiB/s). Bytes are
+    independent streams through the SAME bit-matrix, so pack nb of them
+    block-diagonally and contract nb*8C <= 128 lanes in one pass —
+    nb x fewer MXU passes per word."""
+    nb = max(1, 128 // (8 * cols))
+    return 4 if nb >= 4 else (2 if nb >= 2 else 1)
+
+
+def _row_pad(rows: int) -> int:
+    """Output rows per (byte, bit) plane, padded to the 8-sublane tile.
+
+    The pack stage slices the product at plane boundaries; with rows=m=3
+    those slices straddle sublanes and Mosaic inserts shuffles that cost
+    more than the matmul itself (measured: 7.4 ms of a 17 ms kernel).
+    Zero-padding each plane to 8 rows makes every slice tile-aligned —
+    the padding rows multiply by zero weights and vanish."""
+    return -(-rows // 8) * 8
+
+
+def _lift_bitmatrix_packed(matrix: np.ndarray, nb: int) -> np.ndarray:
+    """Block-diagonal stack of nb planar bit-matrices with sublane-
+    aligned output planes: byte b's bit plane i lands in output rows
+    [(b*8 + i) * rpad, ...+rows). Off-diagonal zeros keep per-row sums
+    <= 8C, so bf16 x bf16 -> f32 accumulation stays exact."""
+    bm = _lift_bitmatrix(matrix)
+    rows, cols = matrix.shape
+    rpad = _row_pad(rows)
+    out = np.zeros((nb * 8 * rpad, nb * 8 * cols), dtype=np.int8)
+    for b in range(nb):
+        for i in range(8):
+            for r in range(rows):
+                for j in range(8):
+                    for c in range(cols):
+                        out[(b * 8 + i) * rpad + r,
+                            (b * 8 + j) * cols + c] = bm[r * 8 + i,
+                                                         c * 8 + j]
+    return out
+
+
 def _pallas_tile(w: int, max_t: int = 8192) -> int | None:
     """Largest lane-tile <= max_t that divides W and is a multiple of 128."""
     t = min(w, max_t)
@@ -214,9 +259,15 @@ def gf_matmul_pallas(matrix: np.ndarray, chunks: jax.Array,
     in HBM — ~50x the minimal traffic. Here each (C, T) input tile is
     unpacked to bit planes, contracted on the MXU (bf16 x bf16 -> f32;
     row sums <= 8C < 2^8 are exact), reduced mod 2, and repacked to
-    uint32 entirely in VMEM: HBM sees only the data in and parity out,
-    the roofline minimum. This is the TPU-native answer to the
-    reference's SIMD GF tables (ErasureCodeIsa.cc:120 ec_encode_data).
+    uint32 entirely in VMEM, so HBM sees only the data in and parity
+    out. Traffic-minimal is not time-minimal, though: measured on v5e,
+    the VPU unpack/pack stages bound this kernel at ~50 GiB/s data-in,
+    while the fully-fused XLA SWAR path reaches 134-240 GiB/s at the
+    same (k=8, m=3) shape — the GF contraction is too narrow (8k x 8m
+    of a 128x128 array) for the MXU to pay for the packing. Kept as
+    the reference MXU formulation and for codes wide enough to fill
+    the array; `auto` resolves to SWAR on TPU (ErasureCodeIsa.cc:120
+    ec_encode_data is the host analog of that choice).
     """
     rows, cols = matrix.shape
     if chunks.shape[-2] != cols:
@@ -228,33 +279,36 @@ def gf_matmul_pallas(matrix: np.ndarray, chunks: jax.Array,
     w = x.shape[-1]
     b = int(np.prod(lead)) if lead else 1
     x3 = x.reshape(b, cols, w)
-    bm = jnp.asarray(_lift_bitmatrix_planar(matrix), dtype=jnp.bfloat16)
+    nb = _bytes_per_dot(cols)
+    bm = jnp.asarray(_lift_bitmatrix_packed(matrix, nb),
+                     dtype=jnp.bfloat16)
     if interpret:
-        out = _gf_pallas_raw(x3, bm, interpret=True)
+        out = _gf_pallas_raw(x3, bm, rows, interpret=True)
     else:
-        out = _partitioned_gf_pallas()(x3, bm)
+        out = _partitioned_gf_pallas(rows)(x3, bm)
     return out.reshape(*lead, rows, w)
 
 
-_PARTITIONED_GF_PALLAS = None
+_PARTITIONED_GF_PALLAS: dict[int, object] = {}
 
 
-def _partitioned_gf_pallas():
+def _partitioned_gf_pallas(rows: int):
     """custom_partitioning wrapper: pallas_call is opaque to GSPMD, but
     this op is independent along the batch and word axes, so under a
     sharded jit each device just runs the kernel on its local (b, C, w)
     shard — zero collectives, matching parallel.chunk_batch_sharding's
     (stripe, width) mesh layout. The chunk axis (C in, R out) and the
-    bit-matrix stay replicated."""
-    global _PARTITIONED_GF_PALLAS
-    if _PARTITIONED_GF_PALLAS is not None:
-        return _PARTITIONED_GF_PALLAS
+    bit-matrix stay replicated. Cached per output-row count (the row
+    count is not derivable from the padded bit-matrix shape)."""
+    cached = _PARTITIONED_GF_PALLAS.get(rows)
+    if cached is not None:
+        return cached
     from jax.experimental.custom_partitioning import custom_partitioning
     from jax.sharding import NamedSharding, PartitionSpec
 
     @custom_partitioning
     def fn(x3, bm):
-        return _gf_pallas_raw(x3, bm,
+        return _gf_pallas_raw(x3, bm, rows,
                               interpret=jax.default_backend() != "tpu")
 
     def _shardings(mesh, arg_shapes):
@@ -272,54 +326,66 @@ def _partitioned_gf_pallas():
         x_sh, bm_sh = _shardings(mesh, arg_shapes)
 
         def lower_fn(x3, bm):
-            return _gf_pallas_raw(x3, bm,
+            return _gf_pallas_raw(x3, bm, rows,
                                   interpret=jax.default_backend() != "tpu")
 
         return mesh, lower_fn, x_sh, (x_sh, bm_sh)
 
     fn.def_partition(infer_sharding_from_operands=infer, partition=partition,
                      sharding_rule="b c w, rr cc -> b r w")
-    _PARTITIONED_GF_PALLAS = fn
+    _PARTITIONED_GF_PALLAS[rows] = fn
     return fn
 
 
-def _gf_pallas_raw(x3: jax.Array, bm: jax.Array,
+def _gf_pallas_raw(x3: jax.Array, bm: jax.Array, rows: int,
                    interpret: bool = False) -> jax.Array:
-    """The pallas_call itself: x3 (B, C, W) u32, bm (8R, 8C) bf16 planar
-    bit-matrix -> (B, R, W) u32. Kept const-free (bm is an argument) so
-    custom_partitioning can wrap it for GSPMD multichip lowering; a
-    non-128-multiple W (e.g. an uneven per-shard slice) is zero-padded to
-    the next lane boundary and sliced back — GF zero rows produce zero
-    outputs, so padding is invisible."""
+    """The pallas_call itself: x3 (B, C, W) u32, bm the packed planar
+    bit-matrix from _lift_bitmatrix_packed -> (B, rows, W) u32. Kept
+    const-free (bm is an argument) so custom_partitioning can wrap it
+    for GSPMD multichip lowering; a non-128-multiple W (e.g. an uneven
+    per-shard slice) is zero-padded to the next lane boundary and sliced
+    back — GF zero rows produce zero outputs, so padding is invisible."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     b, cols, w = x3.shape
-    rows = bm.shape[0] // 8
+    nb = bm.shape[1] // (8 * cols)  # bytes packed per MXU pass
+    rpad = bm.shape[0] // (8 * nb)  # sublane-aligned rows per bit plane
     t = _pallas_tile(w)
     if t is None:
         wpad = -(-w // 128) * 128
         padded = jnp.pad(x3, ((0, 0), (0, 0), (0, wpad - w)))
-        return _gf_pallas_raw(padded, bm, interpret=interpret)[..., :w]
+        return _gf_pallas_raw(padded, bm, rows,
+                              interpret=interpret)[..., :w]
 
     def kernel(x_ref, bm_ref, out_ref):
         xt = x_ref[0]  # (C, T) uint32
-        bmv = bm_ref[:]  # (8R, 8C) bfloat16
-        out = jnp.zeros((rows, t), jnp.uint32)
-        for byte in range(4):
-            xb = (xt >> jnp.uint32(8 * byte)) & jnp.uint32(0xFF)
+        bmv = bm_ref[:]  # (nb*8*rpad, nb*8C) bf16 block-diagonal
+        out = jnp.zeros((rpad, t), jnp.uint32)
+        for g in range(4 // nb):
+            # bit planes of nb bytes stacked down the contraction axis:
+            # row b*8C + j*C + c  <-  bit j of byte g*nb+b of chunk c
             bits = jnp.concatenate(
-                [(xb >> jnp.uint32(j)) & jnp.uint32(1) for j in range(8)],
+                [
+                    (xt >> jnp.uint32(8 * (g * nb + byte) + j))
+                    & jnp.uint32(1)
+                    for byte in range(nb)
+                    for j in range(8)
+                ],
                 axis=0,
-            ).astype(jnp.int32).astype(jnp.bfloat16)  # (8C, T), row j*C+c
+            ).astype(jnp.int32).astype(jnp.bfloat16)  # (nb*8C, T)
             # (Mosaic has no uint32->bf16 cast; int32 hop is free here)
             prod = jnp.dot(bmv, bits, preferred_element_type=jnp.float32)
             par = prod.astype(jnp.int32).astype(jnp.uint32) & jnp.uint32(1)
-            ob = jnp.zeros((rows, t), jnp.uint32)
-            for i in range(8):
-                ob = ob | (par[i * rows:(i + 1) * rows] << jnp.uint32(i))
-            out = out | (ob << jnp.uint32(8 * byte))
-        out_ref[0] = out
+            for byte in range(nb):
+                ob = jnp.zeros((rpad, t), jnp.uint32)
+                for i in range(8):
+                    # rpad-aligned slice: no sublane shuffles
+                    plane = par[(byte * 8 + i) * rpad
+                                : (byte * 8 + i + 1) * rpad]
+                    ob = ob | (plane << jnp.uint32(i))
+                out = out | (ob << jnp.uint32(8 * (g * nb + byte)))
+        out_ref[0] = out[:rows]
 
     return pl.pallas_call(
         kernel,
@@ -328,7 +394,7 @@ def _gf_pallas_raw(x3: jax.Array, bm: jax.Array,
         in_specs=[
             pl.BlockSpec((1, cols, t), lambda i, j: (i, 0, j),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((rows * 8, cols * 8), lambda i, j: (0, 0),
+            pl.BlockSpec(bm.shape, lambda i, j: (0, 0),
                          memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec((1, rows, t), lambda i, j: (i, 0, j),
@@ -353,7 +419,14 @@ _IMPLS = {
 def _resolve_impl(impl: str | None) -> str:
     impl = impl or IMPL
     if impl == "auto":
-        return "pallas" if jax.default_backend() == "tpu" else "mxu"
+        # Measured on v5e (k=8,m=3, 4 MiB stripes): the GF contraction
+        # is only 8k<=64 deep x 8m=24 wide — a sliver of the 128x128
+        # MXU — so the Pallas bit-plane kernel is bound by its VPU
+        # unpack/pack stages (~49 GiB/s data-in), while the SWAR
+        # shift/mask/xor path fuses into one XLA elementwise kernel at
+        # ~134-240 GiB/s data-in, 2.7-5x faster. The MXU only pays off
+        # for contractions that fill it; these codes never do.
+        return "swar" if jax.default_backend() == "tpu" else "mxu"
     if impl not in _IMPLS:
         raise ValueError(
             f"unknown GF matmul impl {impl!r} (CEPH_TPU_GF_IMPL?); "
