@@ -32,6 +32,7 @@ class MonLite:
         hb_grace: float = 1.0,
         out_interval: float = 5.0,
         name: str = "mon",
+        store=None,
     ):
         if crush is None:
             crush = cm.build_flat(n_osds)
@@ -57,6 +58,39 @@ class MonLite:
         #: and a Paxos commit awaits a quorum round mid-mutation —
         #: without this two concurrent snap creates hand out one id
         self._pool_mut_lock = asyncio.Lock()
+        #: MonitorDBStore role: when set, maps/config/paxos state
+        #: persist to the native kv and restore on construction
+        self.store = store
+        if store is not None:
+            self._restore_from_store()
+
+    def _restore_from_store(self) -> None:
+        """Cold boot from disk (MonitorDBStore recovery): the committed
+        map, incremental history, pool-id counter, and config DB."""
+        loaded = self.store.load_map()
+        if loaded is not None:
+            full, _last, history, npool = loaded
+            self.osdmap, _ = menc.decode_osdmap(full)
+            self.history = history
+            self._next_pool_id = npool
+            # daemons must re-announce themselves: mark everything down
+            # until MOSDBoot (the reference mon's post-restart stance)
+            for st in self.osdmap.osds:
+                st.up = False
+        self.config_db = self.store.load_config()
+
+    def _persist_commit(self, inc_epoch: int,
+                        inc_raw: bytes | None) -> None:
+        # every replica tracks the pool-id watermark from applied
+        # incrementals (or a full-map catch-up: inc_raw None), so a
+        # failed-over leader never reuses an id
+        for p in self.osdmap.pools.values():
+            self._next_pool_id = max(self._next_pool_id, p.id + 1)
+        if self.store is None:
+            return
+        self.store.save_map(menc.encode_osdmap(self.osdmap),
+                            self.osdmap.epoch, inc_raw, inc_epoch,
+                            next_pool_id=self._next_pool_id)
 
     # ---------------------------------------------------------- lifecycle
 
@@ -70,6 +104,8 @@ class MonLite:
         if self._watchdog:
             self._watchdog.cancel()
         self.bus.unregister(self.name)
+        if self.store is not None:
+            self.store.close()
 
     # ------------------------------------------------------------ mutation
 
@@ -77,6 +113,7 @@ class MonLite:
         """Apply one incremental and publish it (the Paxos-commit seam)."""
         self.history[inc.epoch] = menc.encode_incremental(inc)
         self.osdmap.apply_incremental(inc)
+        self._persist_commit(inc.epoch, self.history[inc.epoch])
         msg = M.MOSDMapMsg(
             full=b"",
             incrementals=[self.history[inc.epoch]],
@@ -222,6 +259,8 @@ class MonLite:
         set misses it, the lite analog of a store-sync gap), and push
         to every subscriber as MConfig."""
         self.config_db[(msg.who, msg.key)] = msg.value
+        if self.store is not None:
+            self.store.save_config(msg.who, msg.key, msg.value)
         for dst in list(self.subscribers) + self._config_peers():
             await self._push_config(dst)
 

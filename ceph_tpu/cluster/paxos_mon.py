@@ -48,9 +48,11 @@ class PaxosMon(MonLite):
                  hb_grace: float = 1.0, out_interval: float = 5.0,
                  lease_interval: float = 0.3,
                  election_timeout: float = 1.0,
-                 accept_timeout: float = 2.0):
+                 accept_timeout: float = 2.0,
+                 store=None):
         super().__init__(bus, n_osds, crush=crush, hb_grace=hb_grace,
-                         out_interval=out_interval, name=f"mon.{rank}")
+                         out_interval=out_interval, name=f"mon.{rank}",
+                         store=store)
         self.rank = rank
         self.n_mons = n_mons
         self.lease_interval = lease_interval
@@ -75,6 +77,32 @@ class PaxosMon(MonLite):
         self._lease_task: asyncio.Task | None = None
         self._elect_task: asyncio.Task | None = None
         self._commit_lock = asyncio.Lock()
+        if self.store is not None:
+            # recover Paxos obligations (Paxos.h:24-104 first/last
+            # committed + accepted-but-uncommitted value): a peon that
+            # acked a begin before the crash re-proposes it on the next
+            # collect round
+            pn, promised, accepted, uncommitted = self.store.load_paxos()
+            self.promised_pn = promised
+            self.accepted_pn = accepted
+            if uncommitted is not None and \
+                    uncommitted[1] <= self.osdmap.epoch:
+                uncommitted = None  # already committed before the crash
+            self.uncommitted = uncommitted
+            # pn restore: strictly above anything seen pre-crash, on
+            # this rank's residue class (base 100+rank, step n_mons)
+            # so proposal numbers stay globally unique across ranks
+            floor = max(pn, promised, accepted)
+            base = 100 + rank
+            if floor >= base:
+                steps = (floor + 1 - base + n_mons - 1) // n_mons
+                self.pn = base + steps * n_mons
+            self._save_paxos()
+
+    def _save_paxos(self) -> None:
+        if self.store is not None:
+            self.store.save_paxos(self.pn, self.promised_pn,
+                                  self.accepted_pn, self.uncommitted)
 
     # ---------------------------------------------------------- lifecycle
 
@@ -207,6 +235,7 @@ class PaxosMon(MonLite):
         """Paxos::collect — recover uncommitted state from the quorum
         and back-fill lagging peers."""
         self.pn += self.n_mons  # fresh, globally unique pn
+        self._save_paxos()
         self._collect_replies = {}
         self._collect_fut = asyncio.get_running_loop().create_future()
         for r in self.peers():
@@ -281,9 +310,14 @@ class PaxosMon(MonLite):
                 if inc.epoch == self.osdmap.epoch + 1:
                     self.history[inc.epoch] = raw
                     self.osdmap.apply_incremental(inc)
+                    self._persist_commit(inc.epoch, raw)
             if msg.full and self.osdmap.epoch < msg.epoch:
                 m, _ = menc.decode_osdmap(msg.full)
                 self.osdmap = m
+                # full-map catch-up must advance the pool-id watermark
+                # and persist like any commit (a failed-over leader
+                # must never reuse an existing pool id)
+                self._persist_commit(self.osdmap.epoch, None)
         elif isinstance(msg, M.MPing):
             self.subscribers.add(src)
             await super().handle(src, msg)
@@ -294,6 +328,8 @@ class PaxosMon(MonLite):
             # leader's config mirror (ConfigMonitor paxos-store role):
             # a peon that later wins an election keeps serving the DB
             self.config_db = {(w, k): v for w, k, v in msg.entries}
+            if self.store is not None:
+                self.store.replace_config(self.config_db)
         elif isinstance(msg, (M.MOSDBoot, M.MFailure, M.MPoolCreate,
                               M.MPoolSnapOp, M.MConfigSet,
                               M.MUpmapItems)):
@@ -360,6 +396,7 @@ class PaxosMon(MonLite):
     async def _handle_collect(self, src: str, msg: M.MPaxosCollect) -> None:
         if msg.pn > self.promised_pn:
             self.promised_pn = msg.pn
+            self._save_paxos()  # promises survive restarts too
         un = self.uncommitted
         await self.bus.send(
             self.name, src,
@@ -386,6 +423,10 @@ class PaxosMon(MonLite):
         self.promised_pn = msg.pn
         self.accepted_pn = msg.pn
         self.uncommitted = (msg.pn, msg.version, msg.value)
+        # the durability obligation: persist BEFORE acking, or a
+        # crashed peon could forget a value the leader counts as
+        # accepted (Paxos.cc handle_begin stores the txn first)
+        self._save_paxos()
         await self.bus.send(
             self.name, src,
             M.MPaxosAccept(pn=msg.pn, version=msg.version,
@@ -416,6 +457,8 @@ class PaxosMon(MonLite):
         self.osdmap.apply_incremental(inc)
         if self.uncommitted and self.uncommitted[1] <= msg.version:
             self.uncommitted = None
+        self._persist_commit(msg.version, msg.value)
+        self._save_paxos()
 
     async def _request_catchup(self) -> None:
         try:
@@ -443,6 +486,10 @@ class PaxosMon(MonLite):
                 self._accept_futs[key] = fut
                 self._accept_waits.setdefault(key, set())
                 self.uncommitted = (self.pn, inc.epoch, value)
+                # the leader's own acceptance counts toward the
+                # majority, so it carries the same durability
+                # obligation as a peon's
+                self._save_paxos()
                 for r in self.peers():
                     try:
                         await self.bus.send(
@@ -463,6 +510,7 @@ class PaxosMon(MonLite):
                 self._accept_futs.pop(key, None)
                 accepted_by = self._accept_waits.pop(key, set())
                 self.uncommitted = None
+                self._save_paxos()
                 await super().commit(inc)
                 value = self.history[inc.epoch]
                 for r in self.peers():

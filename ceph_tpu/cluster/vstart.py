@@ -40,13 +40,24 @@ class TestCluster:
         self.bus = LocalBus()
         self.n_osds = n_osds
         self.n_mons = n_mons
+
+        def _mon_store(rank: int):
+            # durable clusters put mon state on the native kv too
+            # (MonitorDBStore role) so a cold restart keeps the maps
+            if data_dir is None:
+                return None
+            from .monstore import MonStore
+
+            return MonStore(f"{data_dir}/mon.{rank}.kv")
+
         if n_mons > 1:
             from .paxos_mon import PaxosMon
 
             self.mons: list = [
                 PaxosMon(self.bus, n_osds, rank=r, n_mons=n_mons,
                          crush=crush, hb_grace=hb_grace,
-                         out_interval=out_interval)
+                         out_interval=out_interval,
+                         store=_mon_store(r))
                 for r in range(n_mons)
             ]
             self._mon = None
@@ -54,7 +65,8 @@ class TestCluster:
             self.mons = []
             self._mon = MonLite(self.bus, n_osds, crush=crush,
                                 hb_grace=hb_grace,
-                                out_interval=out_interval)
+                                out_interval=out_interval,
+                                store=_mon_store(0))
         if objectstore == "memstore":
             self.stores = [MemStore() for _ in range(n_osds)]
         else:  # vstart.sh --bluestore role: one store dir per OSD

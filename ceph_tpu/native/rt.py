@@ -110,17 +110,22 @@ class NativeKV:
             _lib.ctkv_close(self._h)
             self._h = None
 
+    def _handle(self):
+        if not self._h:
+            raise KvError("kv store is closed")
+        return self._h
+
     def put(self, key: bytes, value: bytes) -> None:
-        if _lib.ctkv_put(self._h, key, len(key), value, len(value)):
+        if _lib.ctkv_put(self._handle(), key, len(key), value, len(value)):
             raise KvError("put failed")
 
     def delete(self, key: bytes) -> None:
-        if _lib.ctkv_del(self._h, key, len(key)):
+        if _lib.ctkv_del(self._handle(), key, len(key)):
             raise KvError("delete failed")
 
     def get(self, key: bytes) -> bytes | None:
         vlen = ctypes.c_uint64()
-        p = _lib.ctkv_get(self._h, key, len(key), ctypes.byref(vlen))
+        p = _lib.ctkv_get(self._handle(), key, len(key), ctypes.byref(vlen))
         if not p:
             return None
         try:
@@ -141,7 +146,7 @@ class NativeKV:
             else:
                 raise ValueError(f"unknown batch op {op!r}")
         payload = b"".join(parts)
-        rc = _lib.ctkv_batch(self._h, payload, len(payload))
+        rc = _lib.ctkv_batch(self._handle(), payload, len(payload))
         if rc:
             raise KvError(f"batch failed (rc={rc})")
 
@@ -150,7 +155,7 @@ class NativeKV:
         """Sorted items with lo <= key < hi (empty hi = to the end)."""
         count = ctypes.c_uint64()
         buflen = ctypes.c_uint64()
-        p = _lib.ctkv_scan(self._h, lo, len(lo), hi, len(hi), max_items,
+        p = _lib.ctkv_scan(self._handle(), lo, len(lo), hi, len(hi), max_items,
                            ctypes.byref(count), ctypes.byref(buflen))
         try:
             buf = ctypes.string_at(p, buflen.value)
@@ -174,14 +179,14 @@ class NativeKV:
         return self.scan(prefix, _prefix_end(prefix), max_items)
 
     def compact(self) -> None:
-        if _lib.ctkv_compact(self._h):
+        if _lib.ctkv_compact(self._handle()):
             raise KvError("compact failed")
 
     def count(self) -> int:
-        return _lib.ctkv_count(self._h)
+        return _lib.ctkv_count(self._handle())
 
     def wal_size(self) -> int:
-        return _lib.ctkv_wal_size(self._h)
+        return _lib.ctkv_wal_size(self._handle())
 
     def __enter__(self):
         return self
