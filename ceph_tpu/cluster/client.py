@@ -74,7 +74,7 @@ class RadosClient:
             for fut in self._map_waiters:
                 if not fut.done():
                     fut.set_result(None)
-        elif isinstance(msg, M.MPoolSnapReply):
+        elif isinstance(msg, (M.MPoolSnapReply, M.MPoolSetReply)):
             fut = self._snap_ops.get(msg.tid)
             if fut is not None and not fut.done():
                 fut.set_result(msg)
@@ -106,6 +106,9 @@ class RadosClient:
         self._map_waiters = [f for f in self._map_waiters if not f.done()]
         # resend ops whose target moved (Objecter resend-on-map-change)
         for op in list(self._ops.values()):
+            if op.msg.oid and op.msg.pgid[0] in self.osdmap.pools:
+                op.msg.pgid = self.osdmap.object_to_pg(
+                    op.msg.pgid[0], op.msg.oid)
             new_target = self._calc_target(op.msg.pgid)
             if new_target != op.target and new_target >= 0:
                 op.target = new_target
@@ -133,6 +136,11 @@ class RadosClient:
                 M.MMonGetMap(have=self.osdmap.epoch if self.osdmap else 0),
             )
             await asyncio.sleep(0.05 * min(op.attempts, 10))
+            if op.msg.oid:
+                # re-hash: a pg_num change may have moved the object
+                # to a different (split child) PG
+                op.msg.pgid = self.osdmap.object_to_pg(
+                    op.msg.pgid[0], op.msg.oid)
             op.target = self._calc_target(op.msg.pgid)
             if op.target >= 0:
                 op.msg.epoch = self.osdmap.epoch
@@ -325,27 +333,37 @@ class RadosClient:
         map epoch (librados selfmanaged_snap_remove role)."""
         await self._pool_snap_op(pool_id, "remove", snapid)
 
-    async def _pool_snap_op(self, pool_id: int, op: str,
-                            snapid: int) -> "M.MPoolSnapReply":
+    async def set_pool_param(self, pool_id: int, key: str,
+                             value: int) -> None:
+        """Live pool change (`ceph osd pool set` role): key "pg_num"
+        grows PG count (collection split on the OSDs, pow2 only);
+        "pgp_num" re-places the children. Waits for the map epoch."""
+        await self._mon_pool_op(
+            lambda tid: M.MPoolSet(pool_id=pool_id, key=key,
+                                   value=value, tid=tid),
+            f"pool set {key}={value}",
+        )
+
+    async def _mon_pool_op(self, make_msg, what: str):
+        """One tracked mon round-trip: send, await the tid-matched
+        reply, raise on error, wait for the committed map epoch."""
         self._tid += 1
         tid = self._tid
         fut = asyncio.get_running_loop().create_future()
         self._snap_ops[tid] = fut
         try:
-            await self.bus.send(
-                self.name, "mon",
-                M.MPoolSnapOp(pool_id=pool_id, op=op, snapid=snapid,
-                              tid=tid),
-            )
+            await self.bus.send(self.name, "mon", make_msg(tid))
             reply = await asyncio.wait_for(fut, self.op_timeout)
         finally:
             self._snap_ops.pop(tid, None)
         if reply.result != M.OK:
-            raise IOError(f"pool snap op {op} failed: {reply.result}")
-        # wait until our map reflects the epoch (so subsequent writes
-        # carry a SnapContext the OSDs consider current)
+            raise IOError(f"{what} failed: {reply.result}")
+        await self._await_epoch(reply.epoch)
+        return reply
+
+    async def _await_epoch(self, epoch: int) -> None:
         deadline = asyncio.get_running_loop().time() + self.op_timeout
-        while self.osdmap is None or self.osdmap.epoch < reply.epoch:
+        while self.osdmap is None or self.osdmap.epoch < epoch:
             if asyncio.get_running_loop().time() > deadline:
                 break
             try:
@@ -357,7 +375,16 @@ class RadosClient:
             except Exception:
                 pass
             await asyncio.sleep(0.02)
-        return reply
+
+    async def _pool_snap_op(self, pool_id: int, op: str,
+                            snapid: int) -> "M.MPoolSnapReply":
+        # the epoch wait matters here: subsequent writes must carry a
+        # SnapContext the OSDs consider current
+        return await self._mon_pool_op(
+            lambda tid: M.MPoolSnapOp(pool_id=pool_id, op=op,
+                                      snapid=snapid, tid=tid),
+            f"pool snap op {op}",
+        )
 
     async def getxattr(self, pool_id: int, name, key: str) -> bytes:
         reply = await self._submit(

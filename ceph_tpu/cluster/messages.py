@@ -95,6 +95,32 @@ class MPoolSnapReply(Message):
     DEFAULTS = {"tid": 0}
 
 
+@register_message
+class MPoolSet(Message):
+    TYPE = 79
+    # live pool parameter change (the `ceph osd pool set` role);
+    # key: "pg_num" (split) or "pgp_num" (re-place children)
+    FIELDS = (("pool_id", "i32"), ("key", "str"), ("value", "u64"),
+              ("tid", "u64"))
+    DEFAULTS = {"tid": 0}
+
+
+@register_message
+class MPoolSetReply(Message):
+    TYPE = 80
+    FIELDS = (("pool_id", "i32"), ("result", "i32"), ("epoch", "u32"),
+              ("tid", "u64"))
+    DEFAULTS = {"tid": 0}
+
+
+@register_message
+class MPGTempClear(Message):
+    TYPE = 81
+    # acting primary -> mon: migration to the up set is complete, drop
+    # the pg_temp pin (the empty-MOSDPGTemp role)
+    FIELDS = (("pgid", PGID),)
+
+
 # ---------------------------------------------------------- client <-> osd
 
 
@@ -303,6 +329,10 @@ class MPGInfoReply(Message):
 
 @register_message
 class MPushOp(Message):
+    # force=0 (migration pushes): receiver keeps a same-or-newer local
+    # copy — a dual-committed write must not be overwritten by a stale
+    # push. force=1 (recovery/scrub repair): always install — the push
+    # exists to replace bytes the receiver holds wrongly (bit rot).
     TYPE = 42
     FIELDS = (
         ("pgid", PGID),
@@ -312,8 +342,10 @@ class MPushOp(Message):
         ("data", "bytes"),
         ("attrs", "map:str:bytes"),
         ("epoch", "u32"),
+        ("force", "u8"),
         ("last_update", EVERSION),  # pushes end with the log point covered
     )
+    DEFAULTS = {"force": 1}
 
 
 @register_message

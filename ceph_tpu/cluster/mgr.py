@@ -83,6 +83,8 @@ class MgrLite:
                       "PG distribution for a pool: {pool}")
         sock.register("balancer run", self._admin_balancer_run,
                       "apply upmap moves: {pool, max_moves?}")
+        sock.register("autoscaler run", self._admin_autoscaler_run,
+                      "one pg_autoscaler round: {target_per_osd?}")
         await sock.start()
         self.admin = sock
 
@@ -114,6 +116,24 @@ class MgrLite:
             {"pgid": list(p), "pairs": [list(x) for x in pr]}
             for p, pr in moves],
             "before": before}
+
+    async def _admin_autoscaler_run(self, args: dict):
+        return await self.autoscale_once(
+            int(args.get("target_per_osd", 100)))
+
+    async def autoscale_once(self, target_per_osd: int = 100) -> dict:
+        """One pg_autoscaler round (module.py:706 role): plan pg_num /
+        pgp_num growth from the map, submit each change to the mon.
+        pgp_num trails pg_num by one round so member-local collection
+        splits complete before placement changes."""
+        from . import autoscaler
+
+        actions = autoscaler.plan(self.mon.osdmap, target_per_osd)
+        for pool_id, key, value in actions:
+            await self.bus.send(
+                self.name, "mon",
+                M.MPoolSet(pool_id=pool_id, key=key, value=value))
+        return {"actions": [list(a) for a in actions]}
 
     async def handle(self, src: str, msg) -> None:
         if isinstance(msg, M.MMgrReport):

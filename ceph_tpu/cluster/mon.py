@@ -22,6 +22,11 @@ from ..placement import encoding as menc
 from ..placement.osdmap import Incremental, OSDMap
 from . import messages as M
 
+#: hard cap on live pg_num growth (the mon_max_pool_pg_num role): the
+#: single-reactor mon and OSDs walk range(pg_num) synchronously on a
+#: pgp change, so an unbounded request would stall the control plane
+MAX_POOL_PG_NUM = 4096
+
 
 class MonLite:
     def __init__(
@@ -147,6 +152,10 @@ class MonLite:
             await self._handle_pool_create(src, msg)
         elif isinstance(msg, M.MPoolSnapOp):
             await self._handle_pool_snap(src, msg)
+        elif isinstance(msg, M.MPoolSet):
+            await self._handle_pool_set(src, msg)
+        elif isinstance(msg, M.MPGTempClear):
+            await self._handle_pg_temp_clear(msg)
         elif isinstance(msg, M.MConfigSet):
             await self._handle_config_set(msg)
         elif isinstance(msg, M.MUpmapItems):
@@ -245,6 +254,87 @@ class MonLite:
                              result=M.OK, epoch=self.osdmap.epoch,
                              tid=msg.tid),
         )
+
+    async def _handle_pool_set(self, src: str, msg: M.MPoolSet) -> None:
+        """Live pool parameter changes (`ceph osd pool set` role).
+
+        pg_num may only grow, and only between powers of two — the
+        collection-split op is a hash-mask filter, so children must be
+        mask-addressable (the reference's pg_num_pending machinery
+        enforces pow2-aligned splits the same way). pgp_num trails
+        pg_num: bumping it re-places children via normal peering.
+        """
+        import copy
+
+        async def reply(result: int) -> None:
+            await self.bus.send(
+                self.name, src,
+                M.MPoolSetReply(pool_id=msg.pool_id, result=result,
+                                epoch=self.osdmap.epoch, tid=msg.tid),
+            )
+
+        pool0 = self.osdmap.pools.get(msg.pool_id)
+        if pool0 is None:
+            await reply(M.ENOENT)
+            return
+        val = int(msg.value)
+
+        def _pow2(n: int) -> bool:
+            return n > 0 and (n & (n - 1)) == 0
+
+        async with self._pool_mut_lock:
+            pool = copy.deepcopy(self.osdmap.pools[msg.pool_id])
+            if msg.key == "pg_num":
+                if (val < pool.pg_num or not _pow2(val)
+                        or not _pow2(pool.pg_num)
+                        or val > MAX_POOL_PG_NUM):
+                    await reply(-22)
+                    return
+                pool.pg_num = val
+            elif msg.key == "pgp_num":
+                if val < pool.pgp_num or val > pool.pg_num:
+                    await reply(-22)
+                    return
+                pool.pgp_num = val
+            else:
+                await reply(-22)
+                return
+            inc = self._new_inc()
+            inc.new_pools.append(pool)
+            if msg.key == "pgp_num":
+                # pin every re-placed PG to its CURRENT acting set with
+                # pg_temp (the choose_acting/pg_temp arc): the old
+                # members keep serving IO and migrate data to the new
+                # up set, then the primary clears the pin
+                # (MPGTempClear). Without this an EC child whose new
+                # set is disjoint from the old would have no shards.
+                old_acting = {}
+                for ps in range(pool.pg_num):
+                    acting, _ = self.osdmap.pg_to_up_acting_osds(
+                        (pool.id, ps))
+                    old_acting[ps] = acting
+                saved = self.osdmap.pools[msg.pool_id]
+                self.osdmap.pools[msg.pool_id] = pool  # probe new map
+                try:
+                    for ps in range(pool.pg_num):
+                        pgid = (pool.id, ps)
+                        up, _upp, _a, _ap = \
+                            self.osdmap.pg_to_up_acting_full(pgid)
+                        if up != old_acting[ps]:
+                            inc.new_pg_temp[pgid] = old_acting[ps]
+                finally:
+                    self.osdmap.pools[msg.pool_id] = saved
+            await self.commit(inc)
+        await reply(M.OK)
+
+    async def _handle_pg_temp_clear(self, msg: M.MPGTempClear) -> None:
+        """Primary reports migration done: drop the pg_temp pin so the
+        up set takes over (empty-MOSDPGTemp role)."""
+        if msg.pgid not in self.osdmap.pg_temp:
+            return
+        inc = self._new_inc()
+        inc.new_pg_temp[msg.pgid] = []
+        await self.commit(inc)
 
     # -------------------------------------------------------------- config
 

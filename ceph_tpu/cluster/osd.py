@@ -22,6 +22,7 @@ import numpy as np
 
 from ..ec import load_codec
 from ..placement import encoding as menc
+from ..store import transaction as tx_mod
 from ..store.memstore import MemStore
 from ..utils import config as cfg
 from ..utils.admin import AdminSocket
@@ -79,9 +80,20 @@ class ECBatcher:
         for (_cid, _su), items in pending.items():
             codec = items[0][0]
             batch = np.concatenate([stripes for _, stripes, _ in items])
+            # pad the batch axis to a power of two: jit specializes per
+            # shape, and on a tunnel-attached chip each fresh batch size
+            # costs a ~2 s compile — pow2 bucketing caps that at
+            # log2(max batch) compiles (zero stripes encode to zero
+            # parity and are sliced away below)
+            n = len(batch)
+            target = 1 << max(0, (n - 1)).bit_length()
+            if target != n:
+                pad = np.zeros((target - n,) + batch.shape[1:],
+                               dtype=batch.dtype)
+                batch = np.concatenate([batch, pad])
             if self.perf is not None:
                 self.perf.inc("ec_batches")
-                self.perf.observe("ec_batch_stripes", len(batch))
+                self.perf.observe("ec_batch_stripes", n)
             try:
                 parity = np.asarray(codec.encode_batch(batch))
             except Exception:
@@ -143,6 +155,8 @@ class OSDLite:
         self._sinfos: dict[int, object] = {}
         #: pool id -> removed_snaps intervals already trimmed by this OSD
         self._trimmed_snaps: dict[int, list[tuple[int, int]]] = {}
+        #: pool id -> pg_num last seen (detects split transitions)
+        self._pool_pg_num: dict[int, int] = {}
         self._hb_task: asyncio.Task | None = None
         self._worker_task: asyncio.Task | None = None
         self._tasks: set[asyncio.Task] = set()
@@ -162,6 +176,7 @@ class OSDLite:
         p.add_u64_counter("recovery_pushes", "objects pushed to peers")
         p.add_u64_counter("scrubs", "scrub rounds executed")
         p.add_u64_counter("snap_trims", "objects snap-trimmed")
+        p.add_u64_counter("pg_splits", "child PGs split from parents")
         p.add_u64_counter("map_epochs", "osdmap epochs consumed")
 
     # ----------------------------------------------------------- plumbing
@@ -475,15 +490,20 @@ class OSDLite:
         elif isinstance(msg, M.MPushOp):
             # two roles: a primary pushing recovery to us, or the answer
             # to our own MPull (self-recovery) — resolve a pending pull
-            # future if one matches, else install as a peer push
+            # future if one matches, else install as a peer push INTO
+            # THE SHARD THE MESSAGE NAMES (an OSD gaining a new position
+            # via pg_temp migration may also hold an old-position
+            # instance; "existing instance wins" would misroute the
+            # incoming chunk there)
             key = ("push", msg.pgid, self._my_shard(msg.pgid, msg.shard),
                    msg.oid)
-            pg = self._ensure_pg(msg.pgid, self._my_shard(msg.pgid,
-                                                          msg.shard))
             if key in self.pending:
+                pg = self._ensure_pg(msg.pgid,
+                                     self._my_shard(msg.pgid, msg.shard))
                 await pg.handle_push(src, msg)
                 self._resolve(key, msg)
             else:
+                pg = self._ensure_pg(msg.pgid, msg.shard)
                 await pg.handle_push(src, msg)
         elif isinstance(msg, M.MPushReply):
             osd_id = int(src[4:])
@@ -543,12 +563,99 @@ class OSDLite:
         key = (pgid[0], pgid[1], shard)
         pg = self.pgs.get(key)
         if pg is None:
+            self._maybe_split(pgid, shard)
             pg = PG(self, pgid, shard)
             if self.osdmap is not None and pgid[0] in self.osdmap.pools:
                 pg.acting, pg.primary = \
                     self.osdmap.pg_to_up_acting_osds(pgid)
             self.pgs[key] = pg
         return pg
+
+    def _split_pool_children(self, pool, prev_pg_num: int) -> None:
+        """Eager PG split on a pg_num transition (PG::split_into role,
+        PG.cc:546): every child in [prev, new) splits from its TRUE
+        parent (child & (prev-1)) if this OSD holds it — objects whose
+        head-oid hash lands in the child under the new mask move over
+        atomically, and the child's log anchors at the parent's head,
+        so peering sees the child as current on exactly the members
+        that held the parent. Children keep the parent's placement
+        until pgp_num rises (the reference sequences pg_num before
+        pgp_num the same way), so members split in lockstep."""
+        from .pg import META_OID
+        from .pglog import PGLog
+
+        n = pool.pg_num
+        if n & (n - 1) or prev_pg_num & (prev_pg_num - 1):
+            return  # splits only defined between pow2 pg_num values
+        nbits = n.bit_length() - 1
+        colls = set(self.store.list_collections())
+        prefix = f"{pool.id}."
+        for c in range(prev_pg_num, n):
+            p = c & (prev_pg_num - 1)
+            for pcid in colls:
+                if not pcid.startswith(prefix):
+                    continue
+                body = pcid[len(prefix):]
+                ps_s, _, suffix = body.partition("s")
+                if int(ps_s) != p:
+                    continue
+                cid = f"{prefix}{c}" + (f"s{suffix}" if suffix else "")
+                if cid in colls:
+                    continue
+                t = tx_mod.Transaction()
+                t.create_collection(cid)
+                t.split_collection(pcid, nbits, c, cid)
+                child_log = PGLog()
+                try:
+                    raw = self.store.read(pcid, META_OID)
+                    if raw:
+                        plog, _ = PGLog.decode(raw)
+                        child_log.tail = plog.head
+                except Exception:
+                    pass
+                t.write(cid, META_OID, 0, child_log.encode())
+                self.store.queue_transaction(t)
+                self.perf.inc("pg_splits")
+
+    def _maybe_split(self, pgid, shard: int) -> None:
+        """Lazy split fallback for members that missed the pg_num
+        transition (revived mid-history): move the child's objects out
+        of ANY existing proper ancestor — each split filters with the
+        full current mask, so non-containers contribute nothing. The
+        child log stays at ZERO (no fabricated progress): a member
+        whose data arrived this way recovers authoritatively from
+        peers that anchored at the real parent's head."""
+        if self.osdmap is None or pgid[0] not in self.osdmap.pools:
+            return
+        pool = self.osdmap.pools[pgid[0]]
+        n = pool.pg_num
+        if n & (n - 1):
+            return
+        c = pgid[1]
+        suffix = f"s{shard}" if shard >= 0 else ""
+        cid = f"{pgid[0]}.{c}{suffix}"
+        colls = self.store.list_collections()
+        if cid in colls:
+            return
+        nbits = n.bit_length() - 1
+        ancestors = []
+        seen = set()
+        for b in range(nbits - 1, -1, -1):
+            p = c & ((1 << b) - 1)
+            if p == c or p in seen:
+                continue
+            seen.add(p)
+            pcid = f"{pgid[0]}.{p}{suffix}"
+            if pcid in colls:
+                ancestors.append(pcid)
+        if not ancestors:
+            return
+        t = tx_mod.Transaction()
+        t.create_collection(cid)
+        for pcid in ancestors:
+            t.split_collection(pcid, nbits, c, cid)
+        self.store.queue_transaction(t)
+        self.perf.inc("pg_splits")
 
     # ----------------------------------------------------------- map flow
 
@@ -572,6 +679,11 @@ class OSDLite:
             # reference OSD restarts its boot sequence on seeing itself
             # down in a new map)
             await self.bus.send(self.name, "mon", M.MOSDBoot(osd=self.id))
+        for pool in self.osdmap.pools.values():
+            prev = self._pool_pg_num.get(pool.id, pool.pg_num)
+            if pool.pg_num > prev:
+                self._split_pool_children(pool, prev)
+            self._pool_pg_num[pool.id] = pool.pg_num
         self._scan_pgs()
         self._kick_snap_trim()
 

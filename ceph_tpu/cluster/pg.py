@@ -34,6 +34,7 @@ import numpy as np
 from .. import native
 from ..store import NotFound
 from ..store import transaction as tx
+from ..store.base import PGMETA_OID
 from ..utils import denc
 from ..utils import trace as tr
 from . import messages as M
@@ -51,7 +52,7 @@ if TYPE_CHECKING:
     from .osd import OSDLite
 
 NONE = 0x7FFFFFFF  # placement ITEM_NONE
-META_OID = b"_pgmeta"
+META_OID = PGMETA_OID  # the per-PG metadata object (store/base.py)
 
 ATTR_V = "v"
 ATTR_SIZE = "size"
@@ -354,6 +355,11 @@ class PG:
         self.waiting: list[tuple[str, M.MOSDOp]] = []
         self.lock = asyncio.Lock()
         self._peer_task: asyncio.Task | None = None
+        #: pg_temp migration state (acting != up): objects already
+        #: pushed to the incoming up members — writes to these dual-
+        #: commit on both sets so no update is lost at handoff
+        self.migrated: set[bytes] = set()
+        self._migrate_task: asyncio.Task | None = None
         self._load()
 
     # ----------------------------------------------------------- identity
@@ -370,7 +376,7 @@ class PG:
         return self.primary == self.osd.id
 
     def live_members(self) -> list[tuple[int, int]]:
-        """[(osd, shard)] of up members per the CURRENT map, holes
+        """[(osd, shard)] of acting members per the CURRENT map, holes
         skipped. Computed from the osdmap (not the cached acting set) so
         the data path never acts on a stale membership snapshot."""
         up, _ = self.osd.osdmap.pg_to_up_acting_osds(self.pgid)
@@ -378,6 +384,25 @@ class PG:
         for pos, o in enumerate(up):
             if o != NONE:
                 out.append((o, pos if self.is_ec else -1))
+        return out
+
+    def up_extras(self) -> list[tuple[int, int]]:
+        """[(osd, pos)] of UP members not in the acting set — the
+        incoming members of a pg_temp-pinned migration (acting keeps
+        serving while data flows to up; empty when acting == up)."""
+        up, _upp, acting, _ap = self.osd.osdmap.pg_to_up_acting_full(
+            self.pgid)
+        if up == acting:
+            return []
+        out = []
+        for pos, o in enumerate(up):
+            if o == NONE:
+                continue
+            if self.is_ec:
+                if pos >= len(acting) or acting[pos] != o:
+                    out.append((o, pos))
+            elif o not in acting:
+                out.append((o, -1))
         return out
 
     # -------------------------------------------------------- persistence
@@ -419,8 +444,18 @@ class PG:
                               primary != self.primary)
         self.acting = list(acting)
         self.primary = primary
-        if not membership_changed and self.state == "active":
+        if self.is_ec and not (self.shard < len(acting)
+                               and acting[self.shard] == self.osd.id):
+            # this instance's shard position moved to another OSD (a
+            # pgp re-placement): it is a stray now — serve sub-ops,
+            # never drive peering (the serving instance is the one
+            # whose key matches the acting position)
+            self.state = "active"
+            self._flush_waiting_stale()
             return
+        if not membership_changed and self.state == "active":
+            self.kick_migration()  # a pgp change pins pg_temp without
+            return                 # touching the acting set
         if self.is_primary():
             if membership_changed or self.state != "active":
                 self.state = "peering"
@@ -457,6 +492,18 @@ class PG:
                               outs=[], epoch=self.osd.osdmap.epoch),
             )
             return
+        if m.oid and self.osd.osdmap.object_to_pg(
+                self.pgid[0], m.oid) != self.pgid:
+            # the object maps elsewhere under OUR map (e.g. a pg_num
+            # split moved it to a child while the client targeted the
+            # parent): bounce so the client re-hashes on a fresh map —
+            # accepting it would strand the object in the wrong PG
+            await self.osd.send(
+                src,
+                M.MOSDOpReply(tid=m.tid, result=M.ESTALE, data=b"", size=0,
+                              outs=[], epoch=self.osd.osdmap.epoch),
+            )
+            return
         if self.state != "active":
             self.waiting.append((src, m))
             return
@@ -487,6 +534,11 @@ class PG:
                 o for o in objs
                 if o != META_OID and not sn.is_clone_oid(o)
                 and not self._is_whiteout(o)
+                # stray shield: objects left behind by a missed split
+                # (e.g. a member revived mid-transition) map elsewhere
+                # under the current pg_num and must not be listed here
+                and self.osd.osdmap.object_to_pg(self.pgid[0], o)
+                == self.pgid
             )
             out = denc.enc_list(oids, denc.enc_bytes)
             await self.osd.send(
@@ -843,18 +895,36 @@ class PG:
         t.setattr(cid, oid, ATTR_V, enc_ver(version))
         return t
 
+    def _dual_write_extras(self, oid: bytes,
+                           st8: "_OpState | None") -> list[tuple[int, int]]:
+        """Incoming up members that must also receive this write: those
+        already holding the object (migrated, so the delta applies to a
+        complete copy) or seeing it created fresh. Not-yet-migrated
+        objects skip the extras — the migration push carries the final
+        content later."""
+        extras = self.up_extras()
+        if not extras:
+            return []
+        if oid in self.migrated or (st8 is not None and not st8.exists0):
+            self.migrated.add(oid)
+            return extras
+        return []
+
     async def _write_replicated(self, oid: bytes, st8: _OpState,
                                 entries: list[Entry]) -> None:
         version = entries[-1].version
         mut = self._rep_mutation_txn(self.cid, oid, st8, version)
-        await self._rep_fanout(mut, entries)
+        await self._rep_fanout(mut, entries,
+                               extras=self._dual_write_extras(oid, st8))
 
     async def _rep_fanout(self, mut: tx.Transaction,
-                          entries: list[Entry]) -> None:
+                          entries: list[Entry], extras=()) -> None:
         """Apply a mutation transaction locally (primary orders), fan it
-        out to replicas, ack on all-commit."""
+        out to replicas (plus any incoming pg_temp-migration members),
+        ack on all-commit."""
         peers = [(o, s) for o, s in self.live_members()
                  if o != self.osd.id]
+        peers += [(o, s) for o, s in extras if o != self.osd.id]
         local = tx.Transaction()
         self._ensure_coll(local)
         local.ops.extend(self._filter_remote_ops(mut))
@@ -907,7 +977,8 @@ class PG:
                 t.remove(self._shard_cid(pos), oid)
                 shard_txns[pos] = t
             await self._ec_fanout(oid, entries, shard_txns, hpatch=b"",
-                                  ncells=0, size=0, live=live)
+                                  ncells=0, size=0, live=live,
+                                  extras=self._dual_write_extras(oid, st8))
             return
         if st8.deleted:  # whiteout: keep head shell for its clones
             shard_txns = {}
@@ -923,7 +994,8 @@ class PG:
                     t.setattr(cid, oid, name, val)
                 shard_txns[pos] = t
             await self._ec_fanout(oid, entries, shard_txns, hpatch=b"",
-                                  ncells=0, size=0, live=live)
+                                  ncells=0, size=0, live=live,
+                                  extras=self._dual_write_extras(oid, st8))
             return
 
         if st8.full_replace:
@@ -1050,7 +1122,8 @@ class PG:
             shard_txns[pos] = t
             hpatches[pos] = patch.tobytes()
         await self._ec_fanout(oid, entries, shard_txns, hpatch=hpatches,
-                              ncells=new_nst, size=new_size, live=live)
+                              ncells=new_nst, size=new_size, live=live,
+                              extras=self._dual_write_extras(oid, st8))
 
     def _ec_clone_ops(self, t: tx.Transaction, pos: int, oid: bytes,
                       st8: _OpState) -> None:
@@ -1066,33 +1139,39 @@ class PG:
     async def _ec_fanout(self, oid: bytes, entries: list[Entry],
                          shard_txns: dict[int, tx.Transaction],
                          hpatch, ncells: int, size: int,
-                         live: dict[int, int]) -> None:
+                         live: dict[int, int], extras=()) -> None:
         """Apply the local shard's transaction and fan sub-writes out to
-        the other shards; ack when every live shard commits."""
+        the other shards (plus any incoming pg_temp-migration members);
+        ack when every live shard commits."""
         osd = self.osd
         version = entries[-1].version
         waits = []
         for pos, t in shard_txns.items():
-            target = live.get(pos)
-            if target is None:
+            targets = []
+            if live.get(pos) is not None:
+                targets.append(live[pos])
+            targets += [o for o, p in extras if p == pos]
+            if not targets:
                 continue  # degraded write: the hole recovers via peering
             hp = hpatch[pos] if isinstance(hpatch, dict) else hpatch
-            if target == osd.id:
-                self._apply_shard_write(self._shard_cid(pos), t,
-                                        entries, hp, ncells, size,
-                                        version)
-                continue
-            subtid = osd.new_subtid()
-            fut = osd.expect_reply(subtid)
-            waits.append((target, subtid, fut))
-            await osd.send(
-                f"osd.{target}",
-                M.MECSubWrite(tid=subtid, pgid=self.pgid, shard=pos,
-                              txn=t.encode(), entry=enc_entries(entries),
-                              epoch=osd.osdmap.epoch, hpatch=hp,
-                              ncells=ncells, size=size,
-                              trace=_trace_ctx()),
-            )
+            for target in targets:
+                if target == osd.id:
+                    self._apply_shard_write(self._shard_cid(pos), t,
+                                            entries, hp, ncells, size,
+                                            version)
+                    continue
+                subtid = osd.new_subtid()
+                fut = osd.expect_reply(subtid)
+                waits.append((target, subtid, fut))
+                await osd.send(
+                    f"osd.{target}",
+                    M.MECSubWrite(tid=subtid, pgid=self.pgid, shard=pos,
+                                  txn=t.encode(),
+                                  entry=enc_entries(entries),
+                                  epoch=osd.osdmap.epoch, hpatch=hp,
+                                  ncells=ncells, size=size,
+                                  trace=_trace_ctx()),
+                )
         await osd.gather(waits)
 
     def _apply_shard_write(self, cid: str, t: tx.Transaction,
@@ -1317,9 +1396,28 @@ class PG:
 
     # ================================================== sub-op handlers ==
 
+    def _subop_misdirected(self, oid: bytes) -> bool:
+        """A sub-op for an object that maps to a different PG under OUR
+        map (a pg_num split raced the primary's fan-out): applying it
+        would strand the object in a post-split parent collection —
+        reject so the primary fails the op and the client re-targets."""
+        head = sn.parse_clone_oid(oid)[0] if sn.is_clone_oid(oid) else oid
+        try:
+            return self.osd.osdmap.object_to_pg(
+                self.pgid[0], head) != self.pgid
+        except Exception:
+            return False
+
     async def handle_rep_op(self, src: str, m: M.MOSDRepOp) -> None:
         t, _ = tx.Transaction.decode(m.txn)
         entries = dec_entries(m.entry)
+        if self._subop_misdirected(entries[-1].oid):
+            await self.osd.send(
+                src,
+                M.MOSDRepOpReply(tid=m.tid, pgid=self.pgid,
+                                 result=M.ESTALE, osd=self.osd.id),
+            )
+            return
         full = tx.Transaction()
         if self.cid not in self.osd.store.list_collections():
             full.create_collection(self.cid)
@@ -1340,6 +1438,13 @@ class PG:
     async def handle_ec_write(self, src: str, m: M.MECSubWrite) -> None:
         t, _ = tx.Transaction.decode(m.txn)
         entries = dec_entries(m.entry)
+        if self._subop_misdirected(entries[-1].oid):
+            await self.osd.send(
+                src,
+                M.MECSubWriteReply(tid=m.tid, pgid=self.pgid,
+                                   shard=m.shard, result=M.ESTALE),
+            )
+            return
         self._apply_shard_write(self.cid, t, entries, m.hpatch, m.ncells,
                                 m.size, entries[-1].version)
         self.osd.perf.inc("subop_w")
@@ -1498,10 +1603,72 @@ class PG:
             return False
         self.state = "active"
         osd.kick_pg_snap_trim(self)  # new primary: catch up on removals
+        self.kick_migration()
         waiting, self.waiting = self.waiting, []
         for src, m in waiting:
             osd.spawn(self.do_op(src, m))
         return True
+
+    # ================================================ pg_temp migration ==
+
+    def kick_migration(self) -> None:
+        """Start (or restart) pushing this PG's data to the incoming up
+        members when acting is pg_temp-pinned (the backfill-to-up arc
+        behind a pgp_num change)."""
+        if not self.is_primary() or self.state != "active":
+            return
+        if not self.up_extras():
+            self.migrated.clear()
+            return
+        if self._migrate_task is None or self._migrate_task.done():
+            self._migrate_task = asyncio.get_running_loop().create_task(
+                self._migrate_to_up())
+
+    async def _migrate_to_up(self) -> None:
+        osd = self.osd
+        try:
+            extras = self.up_extras()
+            if not extras:
+                return
+            try:
+                oids = [o for o in osd.store.list_objects(self.cid)
+                        if o != META_OID]
+            except NotFound:
+                oids = []
+            for oid in oids:
+                if not self.is_primary() or self.state != "active":
+                    return  # superseded; the next primary restarts
+                if oid in self.migrated:
+                    continue
+                # mark BEFORE pushing: a write racing the push then
+                # dual-commits to the extras with a newer version, and
+                # the in-flight stale push loses to the version guard
+                self.migrated.add(oid)
+                for _attempt in range(5):
+                    v = self._object_version(oid)
+                    if v == ZERO and not self.osd.store.exists(
+                            self.cid, oid):
+                        # deleted while migrating: propagate the delete
+                        # (a stale content push must not resurrect it)
+                        for o, s in extras:
+                            await self._push_object(
+                                o, s, oid, Entry(OP_DELETE, oid, v))
+                        break
+                    for o, s in extras:
+                        # non-forced: a dual-committed newer copy wins
+                        await self._push_object(
+                            o, s, oid, Entry(OP_MODIFY, oid, v),
+                            force=False)
+                    if self._object_version(oid) == v:
+                        break  # stable across the push: converged
+            # all data on the up set (including dual-committed writes):
+            # ask the mon to drop the pin; the up set takes over on the
+            # next epoch
+            await osd.send("mon", M.MPGTempClear(pgid=self.pgid))
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            osd.log_exc(f"pg {self.pgid} up-migration")
 
     async def _recover_self(self, best_key, best: PGInfo) -> None:
         """Adopt the authoritative log, then repair our own copy: pull
@@ -1571,7 +1738,7 @@ class PG:
             await self._push_object(o, s, oid, Entry(OP_MODIFY, oid, v))
 
     async def _push_object(self, o: int, s: int, oid: bytes,
-                           e: Entry) -> None:
+                           e: Entry, force: bool = True) -> None:
         """Push one object (or its EC chunk) to member (o, shard s)."""
         osd = self.osd
         if e.op == OP_DELETE:
@@ -1592,7 +1759,7 @@ class PG:
                       version=e.version, data=data or b"",
                       attrs=attrs if data is not None else
                       {"_deleted": b"1"},
-                      epoch=osd.osdmap.epoch,
+                      epoch=osd.osdmap.epoch, force=int(force),
                       last_update=self.log.head),
         )
         try:
@@ -1953,7 +2120,21 @@ class PG:
     # ---------------------------------------------- peering-side handlers
 
     async def handle_push(self, src: str, m: M.MPushOp) -> None:
-        """Receive a recovery push: install object + attrs, ack."""
+        """Receive a recovery push: install object + attrs, ack. A push
+        older than our local copy is skipped — during a pg_temp
+        migration a dual-committed write may land before the migration
+        push of the same object, and the stale push must not win."""
+        if (not m.force
+                and not m.attrs.get("_deleted")
+                and self.osd.store.exists(self.cid, m.oid)
+                and self._object_version(m.oid) >= m.version
+                and self._object_version(m.oid) != ZERO):
+            await self.osd.send(
+                src,
+                M.MPushReply(pgid=self.pgid, shard=m.shard, oid=m.oid,
+                             result=M.OK),
+            )
+            return
         t = tx.Transaction()
         self._ensure_coll(t)
         if m.attrs.get("_deleted"):

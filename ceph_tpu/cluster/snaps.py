@@ -86,8 +86,9 @@ class SnapSet:
 
 #: reserved oid prefix for clone objects (the hobject_t snap-field role:
 #: clones live beside the head in the same collection, under a prefix no
-#: client-facing listing returns)
-CLONE_PREFIX = b"\x00s"
+#: client-facing listing returns). Single-sourced from the store layer,
+#: which needs it to keep clones with their heads on collection split.
+from ..store.base import CLONE_PREFIX  # noqa: E402
 
 
 def clone_oid(oid: bytes, cloneid: int) -> bytes:

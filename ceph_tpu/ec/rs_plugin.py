@@ -122,26 +122,21 @@ class RSCodec(ErasureCode):
     # ----------------------------------------------------- byte interface
 
     def encode_chunks(self, data_chunks: np.ndarray) -> np.ndarray:
+        """Scalar byte API: always host-native. jit specializes per
+        shape, and scalar callers (recovery, scrub repair, tools) come
+        with arbitrary per-object chunk lengths — on a tunnel-attached
+        chip every fresh shape would cost a multi-second compile. The
+        "device" backend applies to the BATCHED uniform-shape APIs
+        (encode_batch/decode_batch), which is where the device wins.
+        Both paths are bit-exact (tests/test_rs.py pins them equal)."""
         data_chunks = np.ascontiguousarray(data_chunks, dtype=np.uint8)
-        if self.backend == "host":
-            return native.rs_encode(self.matrix, data_chunks)
-        from ..ops import rs
-
-        packed = rs.pack_u32(data_chunks[None])
-        return rs.unpack_u32(np.asarray(self.encode_batch(packed)))[0]
+        return native.rs_encode(self.matrix, data_chunks)
 
     def decode_chunks(self, present, chunks: np.ndarray):
         present = list(present)
         chunks = np.ascontiguousarray(chunks, dtype=np.uint8)
-        if self.backend == "host":
-            data = native.rs_decode(self.matrix, present, chunks)
-        else:
-            from ..ops import rs
-
-            packed = rs.pack_u32(chunks[None])
-            data = rs.unpack_u32(
-                np.asarray(self.decode_batch(tuple(present), packed))
-            )[0]
+        # scalar path: host-native (see encode_chunks — shapes vary)
+        data = native.rs_decode(self.matrix, present, chunks)
         out = {i: data[i] for i in range(self.k)}
         missing_parity = set(range(self.k, self.k + self.m)) - set(present)
         if missing_parity:

@@ -15,6 +15,29 @@ from typing import Callable, Iterable
 from . import transaction as tx
 
 
+#: reserved oid prefix for snapshot clone objects (single source of
+#: truth — cluster/snaps.py builds clone oids from this): CLONE_PREFIX +
+#: 8-byte BE cloneid + NUL + head oid
+CLONE_PREFIX = b"\x00s"
+
+#: the per-PG metadata object (cluster/pg.py META_OID single source)
+PGMETA_OID = b"_pgmeta"
+
+
+def split_hash_oid(oid: bytes) -> bytes | None:
+    """The oid a collection split hashes to decide placement, or None
+    for objects pinned to their collection (per-PG metadata only — an
+    exact match, so client oids that merely share the prefix still
+    migrate). Snapshot clones hash by their embedded HEAD oid so they
+    always migrate with their head (the reference's hobject hash is
+    head-based)."""
+    if oid == PGMETA_OID:
+        return None
+    if oid.startswith(CLONE_PREFIX):
+        return oid[11:]
+    return oid
+
+
 class StoreError(Exception):
     pass
 
@@ -134,10 +157,12 @@ class ObjectStore:
             mask = (1 << op.args["bits"]) - 1
             from ..placement.osdmap import ceph_str_hash_rjenkins
 
-            moving = [
-                oid for oid in src.objects
-                if ceph_str_hash_rjenkins(oid) & mask == op.args["rem"]
-            ]
+            moving = []
+            for oid in src.objects:
+                key = split_hash_oid(oid)
+                if key is not None and \
+                        ceph_str_hash_rjenkins(key) & mask == op.args["rem"]:
+                    moving.append(oid)
             for oid in moving:
                 dest.objects[oid] = src.objects.pop(oid)
             return

@@ -324,9 +324,12 @@ class _Txc:
             src, dest = self.coll(cid), self.coll(a["dest_cid"])
             mask = (1 << a["bits"]) - 1
             from ..placement.osdmap import ceph_str_hash_rjenkins
+            from .base import split_hash_oid
 
             moving = [o for o in src
-                      if ceph_str_hash_rjenkins(o) & mask == a["rem"]]
+                      if split_hash_oid(o) is not None
+                      and ceph_str_hash_rjenkins(split_hash_oid(o))
+                      & mask == a["rem"]]
             for o in moving:
                 dest[o] = src.pop(o)
                 self.dirty.add((cid, o))
