@@ -115,6 +115,13 @@ class ClsContext:
     def omap_keys(self) -> list[bytes]:
         return sorted(self._state["omap"])
 
+    def omap_get_header(self) -> bytes:
+        return self._state["omap_header"]
+
+    def omap_set_header(self, header: bytes) -> None:
+        self._state["omap_header"] = bytes(header)
+        self.mutated = True
+
 
 # ===================================================== built-in: lock
 
@@ -311,3 +318,94 @@ def journal_trim(ctx: ClsContext, inp: bytes) -> bytes:
     ctx.write_full(data[cut:])
     ctx.setxattr("journal.base", denc.enc_u64(upto))
     return b""
+
+
+# ================================================== built-in: rgw
+#
+# The cls_rgw role (src/cls/rgw/): the bucket index lives in the index
+# object's omap and every update is a SERVER-SIDE method, so the entry
+# write and the bucket-stats accounting commit in one atomic op vector
+# — a client-side omap update could never keep stats consistent under
+# concurrent writers. Entry format contract (services/rgw.py
+# _enc_entry): the first 8 bytes are the LE u64 object size; the rest
+# is opaque to this class.
+
+_RGW_STATS_HDR = 24  # header: u64 count, u64 bytes, u64 generation
+
+
+def _rgw_stats(ctx: ClsContext) -> tuple[int, int, int]:
+    hdr = ctx.omap_get_header()
+    if len(hdr) < _RGW_STATS_HDR:
+        return (0, 0, 0)
+    count, off = denc.dec_u64(hdr, 0)
+    nbytes, off = denc.dec_u64(hdr, off)
+    gen, _ = denc.dec_u64(hdr, off)
+    return (count, nbytes, gen)
+
+
+def _rgw_put_stats(ctx: ClsContext, count: int, nbytes: int,
+                   gen: int) -> None:
+    ctx.omap_set_header(denc.enc_u64(count) + denc.enc_u64(nbytes)
+                        + denc.enc_u64(gen))
+
+
+@register("rgw", "index_update", RD | WR)
+def rgw_index_update(ctx: ClsContext, inp: bytes) -> bytes:
+    """One bucket-index mutation: op 0 = put (key, entry), 1 = delete
+    (key). Maintains the stats header atomically with the entry."""
+    op, off = denc.dec_u8(inp, 0)
+    key, off = denc.dec_bytes(inp, off)
+    count, nbytes, gen = _rgw_stats(ctx)
+    old = ctx.omap_get(key)
+    if old is not None:
+        count -= 1
+        nbytes -= denc.dec_u64(old, 0)[0]
+    if op == 0:
+        entry, off = denc.dec_bytes(inp, off)
+        ctx.omap_set(key, entry)
+        count += 1
+        nbytes += denc.dec_u64(entry, 0)[0]
+    elif op == 1:
+        if old is None:
+            raise ClsError(_ENOENT, key.decode(errors="replace"))
+        ctx.omap_rm(key)
+    else:
+        raise ClsError(_EINVAL, f"rgw op {op}")
+    _rgw_put_stats(ctx, max(count, 0), max(nbytes, 0), gen + 1)
+    return b""
+
+
+@register("rgw", "index_get", RD)
+def rgw_index_get(ctx: ClsContext, inp: bytes) -> bytes:
+    key, _ = denc.dec_bytes(inp, 0)
+    entry = ctx.omap_get(key)
+    if entry is None:
+        raise ClsError(_ENOENT, key.decode(errors="replace"))
+    return entry
+
+
+@register("rgw", "index_list", RD)
+def rgw_index_list(ctx: ClsContext, inp: bytes) -> bytes:
+    """Server-side filtered listing (ListObjectsV2 engine): input
+    (prefix, marker, max u32) -> enc_list of (key, entry) + u8
+    truncated. Filtering at the OSD keeps the wire O(page), not
+    O(bucket)."""
+    prefix, off = denc.dec_bytes(inp, off := 0)
+    marker, off = denc.dec_bytes(inp, off)
+    maxk, off = denc.dec_u32(inp, off)
+    keys = [k for k in ctx.omap_keys()  # omap_keys is already sorted
+            if k.startswith(prefix) and k > marker]
+    page = keys[:maxk]
+    truncated = len(keys) > maxk
+    out = [denc.enc_u32(len(page))]
+    for k in page:
+        out.append(denc.enc_bytes(k))
+        out.append(denc.enc_bytes(ctx.omap_get(k)))
+    out.append(denc.enc_u8(1 if truncated else 0))
+    return b"".join(out)
+
+
+@register("rgw", "bucket_stats", RD)
+def rgw_bucket_stats(ctx: ClsContext, inp: bytes) -> bytes:
+    count, nbytes, gen = _rgw_stats(ctx)
+    return denc.enc_u64(count) + denc.enc_u64(nbytes) + denc.enc_u64(gen)

@@ -121,6 +121,40 @@ class MPGTempClear(Message):
     FIELDS = (("pgid", PGID),)
 
 
+# ------------------------------------------------------------ client <-> mds
+
+
+@register_message
+class MClientRequest(Message):
+    TYPE = 82
+    # CephFS metadata request (MClientRequest role): every metadata
+    # mutation goes through the MDS daemon; args are verb-specific
+    FIELDS = (("tid", "u64"), ("verb", "str"), ("args", "map:str:bytes"))
+
+
+@register_message
+class MClientReply(Message):
+    TYPE = 83
+    FIELDS = (("tid", "u64"), ("result", "i32"),
+              ("out", "map:str:bytes"))
+
+
+@register_message
+class MCapRevoke(Message):
+    TYPE = 84
+    # MDS -> client: give back your capability on ino (Locker.h:41
+    # revoke role); the client flushes buffered state and releases
+    FIELDS = (("ino", "u64"), ("tid", "u64"))
+
+
+@register_message
+class MCapRelease(Message):
+    TYPE = 85
+    # client -> MDS: cap released; size carries the flushed file size
+    # (u64 max = nothing buffered)
+    FIELDS = (("ino", "u64"), ("tid", "u64"), ("size", "u64"))
+
+
 # ---------------------------------------------------------- client <-> osd
 
 

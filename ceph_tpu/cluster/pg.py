@@ -724,10 +724,14 @@ class PG:
                     raise OpError(e.code, str(e)) from None
                 if ctx.mutated:
                     # the class mutated arbitrary facets outside the
-                    # overlay: commit as a full-state replace
+                    # overlay: commit as a full-state replace. data/
+                    # xattrs/omap are mutated in place (shared with
+                    # st8); the header is rebound in the state dict,
+                    # so copy it back explicitly.
                     st8.mutated = True
                     st8.full_replace = True
                     st8.ov.size = len(st8._data)
+                    st8._omap_header = ctx._state["omap_header"]
                 if ctx.removed:
                     st8.deleted = True
             else:

@@ -34,6 +34,82 @@ ROOT_OID = b".rgw.root"
 STRIPE_THRESHOLD = 1 << 22  # larger objects stripe
 
 
+# ----------------------------------------------------------- AWS sigv4
+#
+# The rgw_auth_s3.h:262 role: canonical request -> string-to-sign ->
+# HMAC key derivation chain, byte-compatible with the AWS spec so any
+# standard S3 SDK signature validates against the frontend.
+
+import hmac as _hmac
+
+
+def _sha256(b: bytes) -> str:
+    return hashlib.sha256(b).hexdigest()
+
+
+def _hmac256(key: bytes, msg: bytes) -> bytes:
+    return _hmac.new(key, msg, hashlib.sha256).digest()
+
+
+def sigv4_signing_key(secret: str, date: str, region: str,
+                      service: str = "s3") -> bytes:
+    k = _hmac256(("AWS4" + secret).encode(), date.encode())
+    k = _hmac256(k, region.encode())
+    k = _hmac256(k, service.encode())
+    return _hmac256(k, b"aws4_request")
+
+
+def sigv4_canonical_request(method: str, path: str, query: str,
+                            headers: dict[str, str],
+                            signed_headers: list[str],
+                            payload_hash: str) -> str:
+    qs_pairs = urllib.parse.parse_qsl(query, keep_blank_values=True)
+    canon_qs = "&".join(
+        f"{urllib.parse.quote(k, safe='-_.~')}="
+        f"{urllib.parse.quote(v, safe='-_.~')}"
+        for k, v in sorted(qs_pairs))
+    canon_headers = "".join(
+        f"{h}:{' '.join(headers.get(h, '').split())}\n"
+        for h in signed_headers)
+    return "\n".join([
+        method,
+        urllib.parse.quote(path, safe="/-_.~"),
+        canon_qs,
+        canon_headers,
+        ";".join(signed_headers),
+        payload_hash,
+    ])
+
+
+def sigv4_signature(secret: str, date: str, region: str,
+                    amz_date: str, canonical: str) -> str:
+    """scope + string-to-sign + final HMAC — shared by the client-side
+    signer and the frontend validator so the two can never drift."""
+    scope = f"{date}/{region}/s3/aws4_request"
+    sts = "\n".join(["AWS4-HMAC-SHA256", amz_date, scope,
+                      _sha256(canonical.encode())])
+    return _hmac.new(sigv4_signing_key(secret, date, region),
+                     sts.encode(), hashlib.sha256).hexdigest()
+
+
+def sigv4_sign(method: str, path: str, query: str,
+               headers: dict[str, str], payload: bytes,
+               access_key: str, secret: str, amz_date: str,
+               region: str = "us-east-1",
+               signed_headers: list[str] | None = None) -> str:
+    """Build the Authorization header value (client side / tests)."""
+    signed = sorted(signed_headers or ["host", "x-amz-content-sha256",
+                                       "x-amz-date"])
+    payload_hash = _sha256(payload)
+    canon = sigv4_canonical_request(method, path, query, headers,
+                                    signed, payload_hash)
+    date = amz_date[:8]
+    scope = f"{date}/{region}/s3/aws4_request"
+    sig = sigv4_signature(secret, date, region, amz_date, canon)
+    return (f"AWS4-HMAC-SHA256 Credential={access_key}/{scope}, "
+            f"SignedHeaders={';'.join(signed)}, Signature={sig}")
+
+
 class RGWError(Exception):
     def __init__(self, code: str, status: int = 400, what: str = ""):
         super().__init__(what or code)
@@ -64,8 +140,70 @@ def _dec_entry(b: bytes) -> dict:
             "multipart": bool(multipart)}
 
 
+class _ClsIndex:
+    """Bucket index operations through the server-side cls_rgw class
+    (cluster/cls.py "rgw"): every update is atomic WITH the bucket
+    stats accounting inside one OSD op vector — the index is no longer
+    a client-maintained omap."""
+
+    def __init__(self, client, pool_id: int):
+        self.client = client
+        self.pool_id = pool_id
+
+    async def put(self, bucket: str, key: str, entry: bytes) -> None:
+        await self.client.execute(
+            self.pool_id, _index_oid(bucket), "rgw", "index_update",
+            denc.enc_u8(0) + denc.enc_bytes(key.encode())
+            + denc.enc_bytes(entry))
+
+    async def delete(self, bucket: str, key: str) -> None:
+        await self.client.execute(
+            self.pool_id, _index_oid(bucket), "rgw", "index_update",
+            denc.enc_u8(1) + denc.enc_bytes(key.encode()))
+
+    async def get(self, bucket: str, key: str) -> dict:
+        try:
+            raw = await self.client.execute(
+                self.pool_id, _index_oid(bucket), "rgw", "index_get",
+                denc.enc_bytes(key.encode()))
+        except KeyError:
+            raise RGWError("NoSuchKey", 404) from None
+        except IOError as e:
+            # transient op failure is NOT absence — do not tell an S3
+            # client the object is gone when the op merely failed
+            raise RGWError("InternalError", 500, str(e)) from None
+        return _dec_entry(raw)
+
+    async def list(self, bucket: str, prefix: str, marker: str,
+                   max_keys: int) -> tuple[list[dict], bool]:
+        raw = await self.client.execute(
+            self.pool_id, _index_oid(bucket), "rgw", "index_list",
+            denc.enc_bytes(prefix.encode())
+            + denc.enc_bytes(marker.encode())
+            + denc.enc_u32(max_keys))
+        n, off = denc.dec_u32(raw, 0)
+        out = []
+        for _ in range(n):
+            k, off = denc.dec_bytes(raw, off)
+            e, off = denc.dec_bytes(raw, off)
+            ent = _dec_entry(e)
+            ent["key"] = k.decode()
+            out.append(ent)
+        truncated, _ = denc.dec_u8(raw, off)
+        return out, bool(truncated)
+
+    async def stats(self, bucket: str) -> dict:
+        raw = await self.client.execute(
+            self.pool_id, _index_oid(bucket), "rgw", "bucket_stats")
+        count, off = denc.dec_u64(raw, 0)
+        nbytes, off = denc.dec_u64(raw, off)
+        gen, _ = denc.dec_u64(raw, off)
+        return {"count": count, "bytes": nbytes, "generation": gen}
+
+
 class RGWLite:
     def __init__(self, client, pool_id: int):
+        self.index = _ClsIndex(client, pool_id)
         self.client = client
         self.pool_id = pool_id
         self.striper = RadosStriper(
@@ -124,10 +262,8 @@ class RGWLite:
         else:
             await self.striper.remove(oid)  # drop stale striped form
             await self.client.write_full(self.pool_id, oid, data)
-        await self.client.omap_set(
-            self.pool_id, _index_oid(bucket),
-            {key.encode(): _enc_entry(len(data), etag, time.time())},
-        )
+        await self.index.put(bucket, key,
+                             _enc_entry(len(data), etag, time.time()))
         return etag
 
     async def get_object(self, bucket: str, key: str) -> tuple[bytes, dict]:
@@ -143,12 +279,14 @@ class RGWLite:
 
     async def head_object(self, bucket: str, key: str) -> dict:
         await self._require_bucket(bucket)
-        idx = await self.client.omap_get(self.pool_id,
-                                         _index_oid(bucket))
-        raw = idx.get(key.encode())
-        if raw is None:
-            raise RGWError("NoSuchKey", 404)
-        return _dec_entry(raw)
+        return await self.index.get(bucket, key)
+
+    async def bucket_stats(self, bucket: str) -> dict:
+        """Server-maintained bucket accounting (cls_rgw stats role):
+        object count + total bytes, kept atomically with every index
+        update."""
+        await self._require_bucket(bucket)
+        return await self.index.stats(bucket)
 
     async def delete_object(self, bucket: str, key: str) -> None:
         meta = await self.head_object(bucket, key)
@@ -162,8 +300,7 @@ class RGWLite:
                 await self.client.delete(self.pool_id, oid)
             except KeyError:
                 pass
-        await self.client.omap_rm(self.pool_id, _index_oid(bucket),
-                                  [key.encode()])
+        await self.index.delete(bucket, key)
 
     async def copy_object(self, src_bucket: str, src_key: str,
                           dst_bucket: str, dst_key: str) -> str:
@@ -172,23 +309,11 @@ class RGWLite:
 
     async def list_objects(self, bucket: str, prefix: str = "",
                            marker: str = "", max_keys: int = 1000):
-        """(entries, truncated) in lexicographic key order — straight
-        off the bucket-index omap (ListObjectsV2 role)."""
+        """(entries, truncated) in lexicographic key order, filtered
+        SERVER-SIDE by the cls_rgw index_list method (ListObjectsV2
+        role) — the wire carries one page, not the whole bucket."""
         await self._require_bucket(bucket)
-        idx = await self.client.omap_get(self.pool_id,
-                                         _index_oid(bucket))
-        keys = sorted(k.decode() for k in idx)
-        out = []
-        for k in keys:
-            if prefix and not k.startswith(prefix):
-                continue
-            if marker and k <= marker:
-                continue
-            if len(out) >= max_keys:
-                return out, True
-            e = _dec_entry(idx[k.encode()])
-            out.append({"key": k, **e})
-        return out, False
+        return await self.index.list(bucket, prefix, marker, max_keys)
 
     # ---------------------------------------------------------- multipart
 
@@ -237,11 +362,9 @@ class RGWLite:
         await self.client.write_full(
             self.pool_id, _data_oid(bucket, key) + ".__manifest", enc
         )
-        await self.client.omap_set(
-            self.pool_id, _index_oid(bucket),
-            {key.encode(): _enc_entry(total, etag, time.time(),
-                                      multipart=True)},
-        )
+        await self.index.put(bucket, key,
+                             _enc_entry(total, etag, time.time(),
+                                        multipart=True))
         return etag
 
     async def _read_multipart(self, bucket: str, key: str) -> bytes:
@@ -291,13 +414,53 @@ def _xml(root: ET.Element) -> bytes:
 
 class S3Frontend:
     """Minimal S3 REST dialect over asyncio TCP (rgw_asio_frontend
-    role): virtual-path addressing, XML bodies, no auth (the reference
-    gates with sigv4; DummyAuth tier here)."""
+    role): virtual-path addressing, XML bodies, and AWS sigv4 request
+    authentication when a user table is configured (rgw_auth_s3.h:262
+    role; without users the frontend stays open, the DummyAuth tier)."""
 
-    def __init__(self, rgw: RGWLite):
+    def __init__(self, rgw: RGWLite,
+                 users: dict[str, str] | None = None):
         self.rgw = rgw
+        #: access_key -> secret (the RGWUserInfo table role)
+        self.users = users or {}
         self._server: asyncio.AbstractServer | None = None
         self.port = 0
+
+    def _authenticate(self, method: str, target: str, headers: dict,
+                      body: bytes) -> str | None:
+        """Validate sigv4; returns an S3 error code or None (ok)."""
+        auth = headers.get("authorization", "")
+        if not auth.startswith("AWS4-HMAC-SHA256 "):
+            return "AccessDenied"
+        try:
+            fields = dict(
+                kv.strip().split("=", 1)
+                for kv in auth[len("AWS4-HMAC-SHA256 "):].split(","))
+            cred = fields["Credential"].split("/")
+            access, date, region = cred[0], cred[1], cred[2]
+            signed = fields["SignedHeaders"].split(";")
+            given_sig = fields["Signature"]
+        except (KeyError, IndexError, ValueError):
+            return "AuthorizationHeaderMalformed"
+        secret = self.users.get(access)
+        if secret is None:
+            return "InvalidAccessKeyId"
+        amz_date = headers.get("x-amz-date", "")
+        if not amz_date.startswith(date):
+            return "SignatureDoesNotMatch"
+        # content hash must match the body (payload integrity)
+        want_hash = headers.get("x-amz-content-sha256", "")
+        if want_hash not in ("UNSIGNED-PAYLOAD", _sha256(body)):
+            return "XAmzContentSHA256Mismatch"
+        parsed = urllib.parse.urlsplit(target)
+        payload_hash = (want_hash if want_hash else _sha256(body))
+        canon = sigv4_canonical_request(
+            method, urllib.parse.unquote(parsed.path), parsed.query,
+            headers, signed, payload_hash)
+        sig = sigv4_signature(secret, date, region, amz_date, canon)
+        if not _hmac.compare_digest(sig, given_sig):
+            return "SignatureDoesNotMatch"
+        return None
 
     async def start(self, host: str = "127.0.0.1", port: int = 0):
         self._server = await asyncio.start_server(self._conn, host, port)
@@ -328,12 +491,23 @@ class S3Frontend:
                 n = int(headers.get("content-length", "0"))
                 if n:
                     body = await reader.readexactly(n)
-                status, rheaders, rbody = await self._route(
-                    method, target, headers, body
-                )
+                if self.users:
+                    err = self._authenticate(method, target, headers,
+                                             body)
+                else:
+                    err = None
+                if err is not None:
+                    el = ET.Element("Error")
+                    ET.SubElement(el, "Code").text = err
+                    status, rheaders, rbody = 403, {
+                        "content-type": "application/xml"}, _xml(el)
+                else:
+                    status, rheaders, rbody = await self._route(
+                        method, target, headers, body
+                    )
                 reason = {200: "OK", 204: "No Content", 404: "Not Found",
-                          400: "Bad Request", 409: "Conflict"}.get(
-                    status, "Error")
+                          400: "Bad Request", 403: "Forbidden",
+                          409: "Conflict"}.get(status, "Error")
                 head = [f"HTTP/1.1 {status} {reason}"]
                 rheaders.setdefault("content-length", str(len(rbody)))
                 rheaders.setdefault("connection", "keep-alive")
