@@ -742,7 +742,12 @@ class PG:
             epoch = self.osd.osdmap.epoch
             seq = self.log.head[1] + 1 + len(entries)
             prior = self._object_version(oid)
-            op_kind = OP_DELETE if st8.deleted else OP_MODIFY
+            # a whiteout delete leaves a head SHELL (SnapSet carrier):
+            # recovery must install it like any object, not remove it —
+            # a DELETE entry would strip replicas of the SnapSet
+            op_kind = (OP_DELETE
+                       if st8.deleted and not st8.whiteout_delete
+                       else OP_MODIFY)
             entries.append(Entry(op_kind, oid, (epoch, seq), prior))
             if self.is_ec:
                 await self._write_ec_rmw(oid, st8, entries)
@@ -1675,17 +1680,19 @@ class PG:
             osd.log_exc(f"pg {self.pgid} up-migration")
 
     async def _recover_self(self, best_key, best: PGInfo) -> None:
-        """Adopt the authoritative log, then repair our own copy: pull
+        """Repair our own copy, THEN adopt the authoritative log: pull
         whole objects from the authoritative peer (replicated) or
         reconstruct our shard's chunks from k survivors (EC — a peer's
-        chunk is shard-specific and useless to us)."""
+        chunk is shard-specific and useless to us).
+
+        Ordering is load-bearing: if the log were adopted first and a
+        pull then failed, the retried peering round would see an
+        up-to-date log, skip recovery, and go active with stale
+        objects — the missing-set must stay derivable from our
+        persisted log until every object actually landed (the
+        reference's pg_missing_t tracks exactly this)."""
         osd = self.osd
         missing = best.log.missing_after(self.log.head)
-        self.log = best.log
-        t = tx.Transaction()
-        self._ensure_coll(t)
-        self._persist_log(t)
-        osd.store.queue_transaction(t)
         o, s = best_key
         if missing is None:
             # too far behind: full backfill; any member's object list is
@@ -1721,6 +1728,12 @@ class PG:
                             epoch=osd.osdmap.epoch),
                 )
                 await asyncio.wait_for(fut, osd.subop_timeout)
+        # every object landed: NOW the authoritative log is ours
+        self.log = best.log
+        t = tx.Transaction()
+        self._ensure_coll(t)
+        self._persist_log(t)
+        osd.store.queue_transaction(t)
 
     async def _recover_own_chunk(self, oid: bytes,
                                  version: tuple[int, int]) -> None:
