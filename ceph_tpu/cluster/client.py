@@ -35,7 +35,6 @@ class RadosClient:
         self.op_timeout = op_timeout
         self._tid = 0
         self._ops: dict[int, _InFlight] = {}
-        self._pools: dict[str, int] = {}
         self._map_waiters: list[asyncio.Future] = []
         self._snap_ops: dict[int, asyncio.Future] = {}
         self._watches: dict[tuple[bytes, int], object] = {}
@@ -46,8 +45,17 @@ class RadosClient:
 
     async def connect(self) -> None:
         self.bus.register(self.name, self.handle)
-        await self.bus.send(self.name, "mon", M.MMonSubscribe(what="osdmap"))
+        await self._mon_send(M.MMonSubscribe(what="osdmap"))
         await self._wait_for_map()
+
+    async def _mon_send(self, msg, deadline_s: float | None = None
+                        ) -> None:
+        """Hunting mon send (see cluster/monclient.py)."""
+        from .monclient import mon_send
+
+        await mon_send(self.bus, self.name, msg,
+                       self.op_timeout if deadline_s is None
+                       else deadline_s)
 
     async def close(self) -> None:
         self.bus.unregister(self.name)
@@ -70,10 +78,9 @@ class RadosClient:
         elif isinstance(msg, M.MOSDOpReply):
             await self._handle_reply(msg)
         elif isinstance(msg, M.MPoolCreateReply):
-            self._pools["_last"] = msg.pool_id
-            for fut in self._map_waiters:
-                if not fut.done():
-                    fut.set_result(None)
+            fut = self._snap_ops.get(msg.tid)
+            if fut is not None and not fut.done():
+                fut.set_result(msg)
         elif isinstance(msg, (M.MPoolSnapReply, M.MPoolSetReply)):
             fut = self._snap_ops.get(msg.tid)
             if fut is not None and not fut.done():
@@ -97,8 +104,8 @@ class RadosClient:
             # missed epochs (e.g. a mon failover moved the subscriber
             # set): ask for a fill
             asyncio.get_running_loop().create_task(
-                self.bus.send(self.name, "mon",
-                              M.MMonGetMap(have=self.osdmap.epoch))
+                self._mon_send(M.MMonGetMap(have=self.osdmap.epoch),
+                               deadline_s=2.0)
             )
         for fut in self._map_waiters:
             if not fut.done():
@@ -131,10 +138,14 @@ class RadosClient:
                         IOError(f"op {msg.tid} failed after retries")
                     )
                 return
-            await self.bus.send(
-                self.name, "mon",
-                M.MMonGetMap(have=self.osdmap.epoch if self.osdmap else 0),
-            )
+            try:
+                await self._mon_send(
+                    M.MMonGetMap(
+                        have=self.osdmap.epoch if self.osdmap else 0),
+                    deadline_s=1.0,
+                )
+            except Exception:
+                pass  # keep resending on whatever map we have
             await asyncio.sleep(0.05 * min(op.attempts, 10))
             if op.msg.oid:
                 # re-hash: a pg_num change may have moved the object
@@ -172,11 +183,11 @@ class RadosClient:
             if asyncio.get_running_loop().time() > deadline:
                 raise KeyError(f"pool {pool_id} not in map")
             try:
-                await self.bus.send(
-                    self.name, "mon",
+                await self._mon_send(
                     M.MMonGetMap(
                         have=self.osdmap.epoch if self.osdmap else 0
                     ),
+                    deadline_s=0.01,
                 )
             except Exception:
                 pass
@@ -256,13 +267,29 @@ class RadosClient:
     # ------------------------------------------------------------ surface
 
     async def create_pool(self, pool: Pool) -> int:
-        fut = asyncio.get_running_loop().create_future()
-        self._map_waiters.append(fut)
-        await self.bus.send(
-            self.name, "mon", M.MPoolCreate(pool=menc._enc_pool(pool))
-        )
-        await asyncio.wait_for(fut, self.op_timeout)
-        return self._pools.get("_last", pool.id)
+        # retried whole: the mon's pool-create is idempotent by (id,
+        # name), so a request or reply lost to a leader failover is
+        # safely re-sent (MonClient resend-on-reconnect role). The
+        # reply is awaited on a tid-keyed future — a generic map-update
+        # future could be resolved by any unrelated commit and hand
+        # back a stale pool id.
+        last_exc: Exception | None = None
+        for _attempt in range(3):
+            self._tid += 1
+            tid = self._tid
+            fut = asyncio.get_running_loop().create_future()
+            self._snap_ops[tid] = fut
+            try:
+                await self._mon_send(
+                    M.MPoolCreate(pool=menc._enc_pool(pool), tid=tid))
+                reply = await asyncio.wait_for(fut, self.op_timeout)
+                await self._await_epoch(reply.epoch)
+                return reply.pool_id
+            except (asyncio.TimeoutError, IOError) as e:
+                last_exc = e
+            finally:
+                self._snap_ops.pop(tid, None)
+        raise IOError(f"pool create failed: {last_exc}")
 
     async def write_full(self, pool_id: int, name, data: bytes,
                          snapc=None) -> None:
@@ -352,7 +379,7 @@ class RadosClient:
         fut = asyncio.get_running_loop().create_future()
         self._snap_ops[tid] = fut
         try:
-            await self.bus.send(self.name, "mon", make_msg(tid))
+            await self._mon_send(make_msg(tid))
             reply = await asyncio.wait_for(fut, self.op_timeout)
         finally:
             self._snap_ops.pop(tid, None)
@@ -367,10 +394,10 @@ class RadosClient:
             if asyncio.get_running_loop().time() > deadline:
                 break
             try:
-                await self.bus.send(
-                    self.name, "mon",
+                await self._mon_send(
                     M.MMonGetMap(
                         have=self.osdmap.epoch if self.osdmap else 0),
+                    deadline_s=0.01,
                 )
             except Exception:
                 pass

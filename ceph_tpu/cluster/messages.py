@@ -67,13 +67,15 @@ class MFailure(Message):
 class MPoolCreate(Message):
     TYPE = 16
     # pool spec shipped as an encoded Pool (placement/encoding._enc_pool)
-    FIELDS = (("pool", "bytes"),)
+    FIELDS = (("pool", "bytes"), ("tid", "u64"))
+    DEFAULTS = {"tid": 0}
 
 
 @register_message
 class MPoolCreateReply(Message):
     TYPE = 17
-    FIELDS = (("pool_id", "i32"), ("epoch", "u32"))
+    FIELDS = (("pool_id", "i32"), ("epoch", "u32"), ("tid", "u64"))
+    DEFAULTS = {"tid": 0}
 
 
 @register_message
@@ -274,9 +276,14 @@ class MOSDRepOp(Message):
         ("txn", "bytes"),  # encoded store Transaction
         ("entry", "bytes"),  # encoded PGLog entry
         ("epoch", "u32"),
+        # primary's log head BEFORE appending `entry`: the replica
+        # refuses to append over a gap (prefix-log invariant — a
+        # revived stale member must recover, not silently adopt the
+        # head version and dodge peering's authority check)
+        ("prev_head", "pair:u32:u64"),
         ("trace", "pair:u64:u64"),  # span ctx (utils/trace; 0,0 = off)
     )
-    DEFAULTS = {"trace": (0, 0)}
+    DEFAULTS = {"trace": (0, 0), "prev_head": (0, 0)}
 
 
 @register_message
@@ -303,9 +310,11 @@ class MECSubWrite(Message):
         ("hpatch", "bytes"),
         ("ncells", "u64"),
         ("size", "u64"),
+        ("prev_head", "pair:u32:u64"),  # see MOSDRepOp.prev_head
         ("trace", "pair:u64:u64"),  # span ctx (utils/trace; 0,0 = off)
     )
-    DEFAULTS = {"trace": (0, 0), "hpatch": b"", "ncells": 0, "size": 0}
+    DEFAULTS = {"trace": (0, 0), "hpatch": b"", "ncells": 0, "size": 0,
+                "prev_head": (0, 0)}
 
 
 @register_message
@@ -626,3 +635,19 @@ class MUpmapItems(Message):
     empty pair list clears that PG's entry)."""
     TYPE = 62
     FIELDS = (("entries", (_enc_upmap_plan, _dec_upmap_plan)),)
+
+
+@register_message
+class MEnvelope(Message):
+    """Process-to-process routing wrapper for the multi-process NetBus
+    (msg/netbus.py): one TCP listener per OS process carries traffic
+    for every entity the process hosts, so the entity-level source and
+    destination ride inside the frame (the reference's entity_addr_t +
+    entity_name_t header fields, msg/Message.h role)."""
+    TYPE = 90
+    FIELDS = (
+        ("src", "str"),
+        ("dst", "str"),
+        ("mtype", "u32"),
+        ("payload", "bytes"),
+    )

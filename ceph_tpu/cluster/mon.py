@@ -140,6 +140,12 @@ class MonLite:
             await self._handle_boot(src, msg)
         elif isinstance(msg, M.MPing):
             self.last_ping[msg.osd] = time.monotonic()
+            if msg.epoch < self.osdmap.epoch:
+                # stale pinger (e.g. an OSD whose subscription was on a
+                # deposed leader): catch it up — a failover must not
+                # strand daemons on the last pre-failover epoch
+                self.subscribers.add(src)
+                await self._send_map(src, msg.epoch)
         elif isinstance(msg, M.MMonGetMap):
             await self._send_map(src, msg.have)
         elif isinstance(msg, M.MMonSubscribe):
@@ -196,6 +202,20 @@ class MonLite:
     async def _handle_pool_create(self, src: str, msg: M.MPoolCreate) -> None:
         pool, _ = menc._dec_pool(msg.pool, 0)
         async with self._pool_mut_lock:
+            existing = next(
+                (p for p in self.osdmap.pools.values()
+                 if p.name == pool.name
+                 and (pool.id < 0 or p.id == pool.id)), None)
+            if existing is not None:
+                # idempotent by (id, name): the client resends when a
+                # reply is lost to a mon failover (MonClient role)
+                await self.bus.send(
+                    self.name, src,
+                    M.MPoolCreateReply(pool_id=existing.id,
+                                       epoch=self.osdmap.epoch,
+                                       tid=msg.tid),
+                )
+                return
             if pool.id < 0:
                 pool.id = self._next_pool_id
             self._next_pool_id = max(self._next_pool_id, pool.id + 1)
@@ -204,7 +224,8 @@ class MonLite:
             await self.commit(inc)
         await self.bus.send(
             self.name, src,
-            M.MPoolCreateReply(pool_id=pool.id, epoch=self.osdmap.epoch),
+            M.MPoolCreateReply(pool_id=pool.id, epoch=self.osdmap.epoch,
+                               tid=msg.tid),
         )
 
     async def _handle_pool_snap(self, src: str, msg: M.MPoolSnapOp) -> None:

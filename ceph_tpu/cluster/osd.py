@@ -174,6 +174,8 @@ class OSDLite:
         p.add_u64_counter("ec_batches", "batched EC device dispatches")
         p.add_histogram("ec_batch_stripes", "stripes per EC batch")
         p.add_u64_counter("recovery_pushes", "objects pushed to peers")
+        p.add_u64_counter("recovery_unfound",
+                          "objects skipped as unrecoverable")
         p.add_u64_counter("scrubs", "scrub rounds executed")
         p.add_u64_counter("snap_trims", "objects snap-trimmed")
         p.add_u64_counter("pg_splits", "child PGs split from parents")
@@ -286,10 +288,16 @@ class OSDLite:
 
     # ---------------------------------------------------------- lifecycle
 
+    async def mon_send(self, msg, deadline_s: float = 5.0) -> None:
+        """Hunting mon send (see cluster/monclient.py)."""
+        from .monclient import mon_send
+
+        await mon_send(self.bus, self.name, msg, deadline_s)
+
     async def start(self) -> None:
         self.stopped = False
         self.bus.register(self.name, self.handle)
-        await self.bus.send(self.name, "mon", M.MOSDBoot(osd=self.id))
+        await self.mon_send(M.MOSDBoot(osd=self.id))
         self._hb_task = asyncio.get_running_loop().create_task(
             self._hb_loop()
         )
@@ -668,9 +676,11 @@ class OSDLite:
             if self.osdmap is None or inc.epoch != self.osdmap.epoch + 1:
                 if self.osdmap is not None and inc.epoch <= self.osdmap.epoch:
                     continue
-                await self.bus.send(
-                    self.name, "mon", M.MMonGetMap(have=self.epoch)
-                )
+                try:
+                    await self.mon_send(M.MMonGetMap(have=self.epoch),
+                                        deadline_s=1.0)
+                except IOError:
+                    pass
                 return
             self.osdmap.apply_incremental(inc)
             self.perf.inc("map_epochs")
@@ -678,7 +688,7 @@ class OSDLite:
             # wrongly marked down while alive: re-assert ourselves (the
             # reference OSD restarts its boot sequence on seeing itself
             # down in a new map)
-            await self.bus.send(self.name, "mon", M.MOSDBoot(osd=self.id))
+            await self.mon_send(M.MOSDBoot(osd=self.id))
         for pool in self.osdmap.pools.values():
             prev = self._pool_pg_num.get(pool.id, pool.pg_num)
             if pool.pg_num > prev:

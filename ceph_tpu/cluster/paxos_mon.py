@@ -46,9 +46,9 @@ class PaxosMon(MonLite):
     def __init__(self, bus, n_osds: int, rank: int, n_mons: int,
                  crush: cm.CrushMap | None = None,
                  hb_grace: float = 1.0, out_interval: float = 5.0,
-                 lease_interval: float = 0.3,
-                 election_timeout: float = 1.0,
-                 accept_timeout: float = 2.0,
+                 lease_interval: float = 0.4,
+                 election_timeout: float = 2.0,
+                 accept_timeout: float = 3.0,
                  store=None):
         super().__init__(bus, n_osds, crush=crush, hb_grace=hb_grace,
                          out_interval=out_interval, name=f"mon.{rank}",
@@ -132,12 +132,20 @@ class PaxosMon(MonLite):
         for t in (self._lease_task, self._elect_task):
             if t:
                 t.cancel()
-        if self.is_leader():
-            try:
-                self.bus.unregister("mon")
-            except Exception:
-                pass
+        self._drop_alias()
         await super().stop()
+
+    def _drop_alias(self) -> None:
+        """Release the public "mon" name IF we hold it. Ownership-
+        checked: by the time a deposed leader processes its loss, the
+        new leader may already have claimed the alias, and popping it
+        blindly would cut every client off mid-election (the round-3
+        flake: MPoolCreate -> SendError while no one held the name)."""
+        try:
+            if self.bus.entities.get("mon") == self.handle:
+                self.bus.unregister("mon")
+        except Exception:
+            pass
 
     # ----------------------------------------------------------- election
 
@@ -161,12 +169,9 @@ class PaxosMon(MonLite):
         if self.n_mons == 1:
             self._become_leader({self.rank})
             return
-        # depose the stale leader (possibly ourselves) for this round
-        if self.is_leader():
-            try:
-                self.bus.unregister("mon")
-            except Exception:
-                pass
+        # NOTE: if we are the (possibly stale) leader we KEEP the
+        # public alias while campaigning — clients must never find the
+        # name unbound; it moves only when a DIFFERENT leader wins
         self.leader = None
         self.election_epoch += 1
         epoch = self.election_epoch
@@ -337,12 +342,7 @@ class PaxosMon(MonLite):
             # (Monitor::forward_request_leader role); commits that race
             # a leadership change fail quietly and the requester retries
             if not self.is_leader():
-                if self.leader is not None:
-                    try:
-                        await self.bus.send(src, f"mon.{self.leader}",
-                                            msg)
-                    except Exception:
-                        pass
+                await self._forward_to_leader(src, msg)
                 return
             try:
                 await super().handle(src, msg)
@@ -350,6 +350,28 @@ class PaxosMon(MonLite):
                 pass
         else:
             await super().handle(src, msg)
+
+    async def _forward_to_leader(self, src: str, msg) -> None:
+        """Monitor::forward_request_leader role. Mid-election there is
+        briefly NO leader; park the request until one is known
+        (bounded) instead of silently discarding it — a dropped
+        MPoolCreate/MPGTempClear would otherwise cost the requester a
+        full op timeout before its own retry."""
+        deadline = time.monotonic() + self.election_timeout * 2
+        while self.leader is None and time.monotonic() < deadline:
+            await asyncio.sleep(0.05)
+        if self.is_leader():
+            try:  # we won the election while the request was parked
+                await super().handle(src, msg)
+            except QuorumLost:
+                pass
+            return
+        if self.leader is None:
+            return  # still electing: the requester's hunt retries
+        try:
+            await self.bus.send(src, f"mon.{self.leader}", msg)
+        except Exception:
+            pass
 
     async def _handle_elect(self, src: str, msg: M.MMonElect) -> None:
         if msg.rank < self.rank:
@@ -361,11 +383,10 @@ class PaxosMon(MonLite):
                 self.leader is None or self.leader >= msg.rank
             ):
                 self.election_epoch = max(self.election_epoch, msg.epoch)
-                if self.is_leader():
-                    try:
-                        self.bus.unregister("mon")
-                    except Exception:
-                        pass
+                # NOTE: deferring is not losing — keep the public alias
+                # until the candidate actually WINS (_handle_victory's
+                # ownership-checked drop); unbinding it here would leave
+                # the name dangling for a full election round
                 self.leader = None
                 self._last_lease = time.monotonic()  # defer window
                 await self.bus.send(
@@ -379,11 +400,8 @@ class PaxosMon(MonLite):
 
     def _handle_victory(self, msg: M.MMonVictory) -> None:
         if msg.leader < self.rank or msg.epoch >= self.election_epoch:
-            if self.is_leader() and msg.leader != self.rank:
-                try:
-                    self.bus.unregister("mon")
-                except Exception:
-                    pass
+            if msg.leader != self.rank:
+                self._drop_alias()
             self.election_epoch = max(self.election_epoch, msg.epoch)
             self.leader = msg.leader
             self.quorum = set(msg.quorum)
@@ -398,16 +416,19 @@ class PaxosMon(MonLite):
             self.promised_pn = msg.pn
             self._save_paxos()  # promises survive restarts too
         un = self.uncommitted
-        await self.bus.send(
-            self.name, src,
-            M.MPaxosLast(
-                pn=msg.pn, rank=self.rank,
-                last_committed=self.osdmap.epoch,
-                uncommitted_pn=un[0] if un else 0,
-                uncommitted_ver=un[1] if un else 0,
-                uncommitted_value=un[2] if un else b"",
-            ),
-        )
+        try:
+            await self.bus.send(
+                self.name, src,
+                M.MPaxosLast(
+                    pn=msg.pn, rank=self.rank,
+                    last_committed=self.osdmap.epoch,
+                    uncommitted_pn=un[0] if un else 0,
+                    uncommitted_ver=un[1] if un else 0,
+                    uncommitted_value=un[2] if un else b"",
+                ),
+            )
+        except Exception:
+            pass  # collector died mid-round; the next election recollects
 
     def _handle_last(self, msg: M.MPaxosLast) -> None:
         if msg.pn == self.pn:
@@ -427,11 +448,14 @@ class PaxosMon(MonLite):
         # crashed peon could forget a value the leader counts as
         # accepted (Paxos.cc handle_begin stores the txn first)
         self._save_paxos()
-        await self.bus.send(
-            self.name, src,
-            M.MPaxosAccept(pn=msg.pn, version=msg.version,
-                           rank=self.rank),
-        )
+        try:
+            await self.bus.send(
+                self.name, src,
+                M.MPaxosAccept(pn=msg.pn, version=msg.version,
+                               rank=self.rank),
+            )
+        except Exception:
+            pass  # proposer died mid-round; recovery re-proposes
 
     def _handle_accept(self, msg: M.MPaxosAccept) -> None:
         key = (msg.pn, msg.version)

@@ -60,6 +60,14 @@ ATTR_HINFO = "hinfo"
 ATTR_SS = "ss"  # head SnapSet (the SS_ATTR role)
 ATTR_WHITEOUT = "wh"  # deleted head kept for its clones (snapdir role)
 USER_ATTR = "u:"  # user xattr namespace within store attrs
+
+
+def _is_recovery_attr(k: str) -> bool:
+    """Attrs a shard read/reconstruction must carry besides the data:
+    user xattrs plus the shard-invariant head metadata. A shard
+    recovered without its SnapSet would later, as primary, read a
+    stale snapset and mis-file clone history (round-4 EC thrash bug)."""
+    return k.startswith(USER_ATTR) or k in (ATTR_SS, ATTR_WHITEOUT)
 OMAP_HDR = "_oh"
 
 
@@ -355,11 +363,34 @@ class PG:
         self.waiting: list[tuple[str, M.MOSDOp]] = []
         self.lock = asyncio.Lock()
         self._peer_task: asyncio.Task | None = None
-        #: pg_temp migration state (acting != up): objects already
-        #: pushed to the incoming up members — writes to these dual-
-        #: commit on both sets so no update is lost at handoff
+        #: pg_temp migration state (acting != up): objects whose full
+        #: state is KNOWN to be on every incoming up member (base push
+        #: acked by all extras with no write racing it, or created
+        #: fresh after the extras appeared) — writes to these dual-
+        #: commit op-granular deltas on both sets so no update is lost
+        #: at handoff. Deltas are only safe on top of a complete base:
+        #: an oid enters this set strictly after its push round.
         self.migrated: set[bytes] = set()
+        #: oids written while NOT in ``migrated`` during a migration —
+        #: the write went to acting only, so the push loop must (re)push
+        #: full state before the oid may enter ``migrated``
+        self.mig_dirty: set[bytes] = set()
+        #: oids created fresh under the extras (the create delta IS the
+        #: full state) whose fan-out is still in flight: they graduate
+        #: to ``migrated`` only when every member ACKS, else they fall
+        #: back to ``mig_dirty`` for the push loop
+        self.mig_fresh: set[bytes] = set()
+        #: extras membership the ``migrated`` set was earned against —
+        #: any change invalidates it (a new extra has no bases)
+        self._mig_extras: frozenset = frozenset()
         self._migrate_task: asyncio.Task | None = None
+        #: newest log entry EVERY acting member acked (primary-only
+        #: state): fan-outs quote it as prev_head so sub-op receivers
+        #: can tell a revived-stale-member gap (reject: must recover)
+        #: from a failed-op gap (absorb: client retries re-apply it).
+        #: Re-seeded from the log head at activation — peering has just
+        #: converged every member to our log by then.
+        self.acked_head: tuple[int, int] = ZERO
         self._load()
 
     # ----------------------------------------------------------- identity
@@ -421,10 +452,17 @@ class PG:
         if self.cid not in self.osd.store.list_collections():
             t.create_collection(self.cid)
 
-    def _persist_log(self, t: tx.Transaction) -> None:
+    def _persist_log(self, t: tx.Transaction,
+                     cid: str | None = None) -> None:
+        """Persist the PG log into `cid` (default: our own collection).
+        EC sub-writes applied on behalf of a co-located second shard
+        must land the log in THAT shard's collection, or it looks
+        empty/behind after a restart and recovers needlessly (round-3
+        advisor finding)."""
         enc = self.log.encode()
-        t.truncate(self.cid, META_OID, 0)
-        t.write(self.cid, META_OID, 0, enc)
+        cid = self.cid if cid is None else cid
+        t.truncate(cid, META_OID, 0)
+        t.write(cid, META_OID, 0, enc)
 
     def _append_and_persist(self, entries: list[Entry],
                             t: tx.Transaction) -> None:
@@ -909,15 +947,37 @@ class PG:
         """Incoming up members that must also receive this write: those
         already holding the object (migrated, so the delta applies to a
         complete copy) or seeing it created fresh. Not-yet-migrated
-        objects skip the extras — the migration push carries the final
-        content later."""
+        objects skip the extras AND mark the oid dirty — a delta must
+        never land on an extra whose base push hasn't been acked (it
+        would materialize a partial object stamped with the new version,
+        which the push path's version guard then refuses to repair;
+        round-3 advisor finding). The push loop re-pushes dirty oids."""
         extras = self.up_extras()
         if not extras:
             return []
-        if oid in self.migrated or (st8 is not None and not st8.exists0):
-            self.migrated.add(oid)
+        if oid in self.migrated:
             return extras
+        if st8 is not None and not st8.exists0:
+            # created fresh under the extras' noses: the delta IS the
+            # full state, so every extra may take it (a stale in-flight
+            # push of a prior incarnation loses to the version guard).
+            # PROVISIONAL until the fan-out all-acks — a fenced/timed-
+            # out extra means the base is NOT there (the fan-out's
+            # completion hooks graduate or demote the oid)
+            self.mig_fresh.add(oid)
+            return extras
+        self.mig_dirty.add(oid)
         return []
+
+    def _mig_fanout_done(self, oid: bytes, ok: bool) -> None:
+        """Graduate (all-acked) or demote (failed) a provisional
+        fresh-create during pg_temp migration."""
+        if oid in self.mig_fresh:
+            self.mig_fresh.discard(oid)
+            if ok:
+                self.migrated.add(oid)
+            else:
+                self.mig_dirty.add(oid)
 
     async def _write_replicated(self, oid: bytes, st8: _OpState,
                                 entries: list[Entry]) -> None:
@@ -950,9 +1010,17 @@ class PG:
                 M.MOSDRepOp(tid=subtid, pgid=self.pgid, txn=enc_txn,
                             entry=enc_entries(entries),
                             epoch=self.osd.osdmap.epoch,
+                            prev_head=self.acked_head,
                             trace=_trace_ctx()),
             )
-        await self.osd.gather(waits)
+        try:
+            await self.osd.gather(waits)
+        except BaseException:
+            self._mig_fanout_done(entries[-1].oid, ok=False)
+            raise
+        self._mig_fanout_done(entries[-1].oid, ok=True)
+        if entries[-1].version > self.acked_head:
+            self.acked_head = entries[-1].version
 
     # -------------------------------------------------------- EC backend
 
@@ -1179,9 +1247,17 @@ class PG:
                                   entry=enc_entries(entries),
                                   epoch=osd.osdmap.epoch, hpatch=hp,
                                   ncells=ncells, size=size,
+                                  prev_head=self.acked_head,
                                   trace=_trace_ctx()),
                 )
-        await osd.gather(waits)
+        try:
+            await osd.gather(waits)
+        except BaseException:
+            self._mig_fanout_done(oid, ok=False)
+            raise
+        self._mig_fanout_done(oid, ok=True)
+        if version > self.acked_head:
+            self.acked_head = version
 
     def _apply_shard_write(self, cid: str, t: tx.Transaction,
                            entries: list[Entry], hpatch: bytes,
@@ -1222,7 +1298,7 @@ class PG:
             if entry.version > self.log.head:
                 self.log.append(entry)
         self.log.trim(osd.log_keep)
-        self._persist_log(full)
+        self._persist_log(full, cid)
         osd.store.queue_transaction(full)
 
     async def _ec_remote_meta(self, oid: bytes):
@@ -1417,10 +1493,37 @@ class PG:
         except Exception:
             return False
 
+    def _subop_fenced(self, src: str, prev_head) -> bool:
+        """Prefix-log + interval fence for incoming sub-writes.
+
+        (a) ``src`` must be OUR current primary: a demoted primary
+        finishing an in-flight fan-out after a map flip must not plant
+        entries on members of the new interval (its op fails; the
+        client re-targets).
+        (b) Our log head must cover the sender's ALL-ACKED head
+        (``prev_head`` = newest entry every acting member acked, NOT
+        the sender's raw log head). Every live member has acked — and
+        therefore holds — everything up to that point, so head <
+        prev_head identifies exactly one situation: a revived stale
+        member that missed all-committed updates. Appending over that
+        gap would hand it the authoritative head version WITHOUT the
+        intervening mutations, the next peering round would skip its
+        recovery, and it would serve resurrected data (the divergent-
+        log hazard the reference's PGLog merge_log guards). Fencing on
+        the raw log head instead would livelock: a partially failed
+        fan-out (e.g. a split misdirect bounced one shard) leaves the
+        primary's log permanently ahead of members that bounced,
+        while the client's retry re-applies the content under a fresh
+        version — such unacked entries are absorbed-by-gap by design."""
+        if src != f"osd.{self.primary}":
+            return True
+        return self.log.head < tuple(prev_head)
+
     async def handle_rep_op(self, src: str, m: M.MOSDRepOp) -> None:
         t, _ = tx.Transaction.decode(m.txn)
         entries = dec_entries(m.entry)
-        if self._subop_misdirected(entries[-1].oid):
+        if (self._subop_fenced(src, m.prev_head)
+                or self._subop_misdirected(entries[-1].oid)):
             await self.osd.send(
                 src,
                 M.MOSDRepOpReply(tid=m.tid, pgid=self.pgid,
@@ -1447,7 +1550,8 @@ class PG:
     async def handle_ec_write(self, src: str, m: M.MECSubWrite) -> None:
         t, _ = tx.Transaction.decode(m.txn)
         entries = dec_entries(m.entry)
-        if self._subop_misdirected(entries[-1].oid):
+        if (self._subop_fenced(src, m.prev_head)
+                or self._subop_misdirected(entries[-1].oid)):
             await self.osd.send(
                 src,
                 M.MECSubWriteReply(tid=m.tid, pgid=self.pgid,
@@ -1514,7 +1618,7 @@ class PG:
                 for k, v in self.osd.store.getattrs(
                     self.cid, m.oid
                 ).items()
-                if k.startswith(USER_ATTR)
+                if _is_recovery_attr(k)
             }
             reply = M.MECSubReadReply(tid=m.tid, pgid=self.pgid,
                                       shard=m.shard, result=M.OK,
@@ -1606,11 +1710,25 @@ class PG:
                 await self._backfill_peer(o, s)
             else:
                 for oid, e in missing.items():
-                    await self._push_object(o, s, oid, e)
+                    if self._subop_misdirected(oid):
+                        continue  # split stray: lives in a child PG now
+                    try:
+                        await self._push_object(o, s, oid, e)
+                    except RuntimeError:
+                        # unreconstructable (e.g. the log entry of a
+                        # bounced degraded write that never reached k
+                        # shards): the client's retry re-created the
+                        # object wherever it maps now — do NOT wedge
+                        # peering forever on it (unfound-object role)
+                        osd.perf.inc("recovery_unfound")
+                        osd.log_exc(f"pg {self.pgid} unfound {oid!r}")
 
         if osd.osdmap.epoch != epoch:
             return False
         self.state = "active"
+        # peering just converged every member to our log: everything in
+        # it counts as acked for the prefix fence
+        self.acked_head = self.log.head
         osd.kick_pg_snap_trim(self)  # new primary: catch up on removals
         self.kick_migration()
         waiting, self.waiting = self.waiting, []
@@ -1626,54 +1744,138 @@ class PG:
         behind a pgp_num change)."""
         if not self.is_primary() or self.state != "active":
             return
-        if not self.up_extras():
+        extras = frozenset(self.up_extras())
+        if not extras:
             self.migrated.clear()
+            self.mig_dirty.clear()
+            self.mig_fresh.clear()
+            self._mig_extras = frozenset()
             return
+        if extras != self._mig_extras:
+            # membership changed: `migrated` was earned against the OLD
+            # extras; a new extra has no bases, so deltas must not flow
+            # to it until the push loop re-establishes full state
+            self.migrated.clear()
+            self._mig_extras = extras
         if self._migrate_task is None or self._migrate_task.done():
             self._migrate_task = asyncio.get_running_loop().create_task(
                 self._migrate_to_up())
 
     async def _migrate_to_up(self) -> None:
+        """Push every object's full state to the incoming up members.
+
+        Protocol invariant (round-3 advisor fix): an oid enters
+        ``self.migrated`` — and thereby starts receiving op-granular
+        write deltas on the extras — only after one push round in which
+        (a) every extra ACKED the full-state push and (b) no client
+        write raced the round (``mig_dirty`` stayed clear). The
+        dirty-check + ``migrated.add`` happen with no await between
+        them, so in the single-reactor model no write can slip into the
+        gap: any write either lands before the check (round retries) or
+        after the add (it dual-commits the delta to now-complete
+        bases). MPGTempClear is only sent once every oid converged."""
         osd = self.osd
         try:
-            extras = self.up_extras()
-            if not extras:
-                return
-            try:
-                oids = [o for o in osd.store.list_objects(self.cid)
-                        if o != META_OID]
-            except NotFound:
-                oids = []
-            for oid in oids:
+            spins = 0
+            last_extras: frozenset = frozenset()
+            #: oids this run decided not to migrate (split strays,
+            #: unfound) — excluded from re-listing or they spin the loop
+            skipped: set[bytes] = set()
+            while True:
                 if not self.is_primary() or self.state != "active":
                     return  # superseded; the next primary restarts
-                if oid in self.migrated:
+                # re-read the extras every round: an unresponsive extra
+                # is eventually marked down and leaves the up set — the
+                # loop must converge on the survivors, not spin forever
+                # pushing to a ghost. A CHANGED set invalidates the
+                # migrated bookkeeping (new extras have no bases).
+                extras = frozenset(self.up_extras())
+                if not extras:
+                    return  # pin dropped / up set collapsed into acting
+                if extras != last_extras:
+                    if last_extras:
+                        self.migrated.clear()
+                    self._mig_extras = extras
+                    last_extras = extras
+                # re-list every round: objects created (and possibly
+                # failed mid-fan-out) after an earlier snapshot must
+                # still be pushed before the pin may drop. Union in the
+                # dirty set: an object DELETED after a partial push is
+                # gone from the listing but its delete must still be
+                # propagated to the extras, or it resurrects at handoff
+                try:
+                    oids = [o for o in osd.store.list_objects(self.cid)
+                            if o != META_OID]
+                except NotFound:
+                    oids = []
+                seen = set(oids)
+                oids += [o for o in self.mig_dirty if o not in seen]
+                pending = [o for o in oids
+                           if o not in self.migrated
+                           and o not in self.mig_fresh
+                           and o not in skipped]
+                if not pending and not self.mig_fresh:
+                    break
+                if not pending:  # only in-flight fresh creates remain
+                    await asyncio.sleep(0.02)
                     continue
-                # mark BEFORE pushing: a write racing the push then
-                # dual-commits to the extras with a newer version, and
-                # the in-flight stale push loses to the version guard
-                self.migrated.add(oid)
-                for _attempt in range(5):
+                retry: list[bytes] = []
+                for oid in pending:
+                    if not self.is_primary() or self.state != "active":
+                        return
+                    if oid in self.migrated:
+                        continue
+                    if self._subop_misdirected(oid):
+                        skipped.add(oid)
+                        continue  # split stray: child PG owns it now
+                    self.mig_dirty.discard(oid)
                     v = self._object_version(oid)
-                    if v == ZERO and not self.osd.store.exists(
-                            self.cid, oid):
-                        # deleted while migrating: propagate the delete
-                        # (a stale content push must not resurrect it)
-                        for o, s in extras:
-                            await self._push_object(
-                                o, s, oid, Entry(OP_DELETE, oid, v))
-                        break
-                    for o, s in extras:
-                        # non-forced: a dual-committed newer copy wins
-                        await self._push_object(
-                            o, s, oid, Entry(OP_MODIFY, oid, v),
-                            force=False)
-                    if self._object_version(oid) == v:
-                        break  # stable across the push: converged
+                    try:
+                        if v == ZERO and not self.osd.store.exists(
+                                self.cid, oid):
+                            # deleted while migrating: propagate the
+                            # delete (a stale content push must not
+                            # resurrect it)
+                            ok = True
+                            for o, s in extras:
+                                ok &= await self._push_object(
+                                    o, s, oid, Entry(OP_DELETE, oid, v))
+                        else:
+                            ok = True
+                            for o, s in extras:
+                                # non-forced: a newer incarnation dual-
+                                # committed fresh on the extra wins
+                                ok &= await self._push_object(
+                                    o, s, oid, Entry(OP_MODIFY, oid, v),
+                                    force=False)
+                    except RuntimeError:
+                        # push/reconstruction failure. Usually transient
+                        # (a survivor shard briefly unreachable): RETRY,
+                        # holding the pin — handing off while the only
+                        # healthy copies are on the acting set would be
+                        # irreversible. Split strays are the permanent
+                        # case and were skipped above.
+                        osd.perf.inc("recovery_unfound")
+                        osd.log_exc(f"pg {self.pgid} unpushable {oid!r}")
+                        retry.append(oid)
+                        continue
+                    # atomic wrt the reactor: no await between the
+                    # dirty/version check and migrated.add
+                    if (ok and oid not in self.mig_dirty
+                            and self._object_version(oid) == v):
+                        self.migrated.add(oid)
+                    else:
+                        retry.append(oid)
+                pending = retry
+                if pending:
+                    # writes (or push timeouts) raced this round; yield
+                    # so the op stream makes progress, then re-push
+                    spins += 1
+                    await asyncio.sleep(min(0.05 * spins, 0.5))
             # all data on the up set (including dual-committed writes):
             # ask the mon to drop the pin; the up set takes over on the
             # next epoch
-            await osd.send("mon", M.MPGTempClear(pgid=self.pgid))
+            await osd.mon_send(M.MPGTempClear(pgid=self.pgid))
         except asyncio.CancelledError:
             raise
         except Exception:
@@ -1718,8 +1920,17 @@ class PG:
         for oid, version in todo.items():
             if self._object_version(oid) == version:
                 continue
+            if self._subop_misdirected(oid):
+                continue  # split stray: belongs to a child PG now
             if self.is_ec:
-                await self._recover_own_chunk(oid, version)
+                try:
+                    await self._recover_own_chunk(oid, version)
+                except RuntimeError:
+                    # unreconstructable (bounced degraded write that
+                    # never reached k shards): skip, don't wedge
+                    # peering (unfound-object role)
+                    osd.perf.inc("recovery_unfound")
+                    osd.log_exc(f"pg {self.pgid} unfound {oid!r}")
             else:
                 fut = osd.expect_reply(("push", self.pgid, self.shard, oid))
                 await osd.send(
@@ -1742,6 +1953,9 @@ class PG:
         self._ensure_coll(t)
         t.truncate(self.cid, oid, 0)
         t.write(self.cid, oid, 0, chunk)
+        # wipe first: attrs the survivors DON'T have (stale ss / wh
+        # from our pre-crash copy) must not outlive recovery
+        t.rmattrs(self.cid, oid)
         t.setattrs(self.cid, oid, {**attrs, ATTR_V: enc_ver(version)})
         self.osd.store.queue_transaction(t)
 
@@ -1749,14 +1963,22 @@ class PG:
         """Push every object to a peer whose log diverged past our tail
         (recover_backfill role — full rescan instead of log delta)."""
         for oid in self.osd.store.list_objects(self.cid):
-            if oid == META_OID:
+            if oid == META_OID or self._subop_misdirected(oid):
                 continue
             v = self._object_version(oid)
-            await self._push_object(o, s, oid, Entry(OP_MODIFY, oid, v))
+            try:
+                await self._push_object(o, s, oid,
+                                        Entry(OP_MODIFY, oid, v))
+            except RuntimeError:
+                self.osd.perf.inc("recovery_unfound")
+                self.osd.log_exc(f"pg {self.pgid} unfound {oid!r}")
 
     async def _push_object(self, o: int, s: int, oid: bytes,
-                           e: Entry, force: bool = True) -> None:
-        """Push one object (or its EC chunk) to member (o, shard s)."""
+                           e: Entry, force: bool = True) -> bool:
+        """Push one object (or its EC chunk) to member (o, shard s).
+        Returns True iff the peer acked — callers that gate delta
+        dual-writes on a complete base (pg_temp migration) must treat
+        a timeout as not-pushed."""
         osd = self.osd
         if e.op == OP_DELETE:
             data, attrs = None, {}
@@ -1767,7 +1989,13 @@ class PG:
                 data = bytes(osd.store.read(self.cid, oid))
                 attrs = osd.store.getattrs(self.cid, oid)
             except Exception:
-                return  # deleted meanwhile
+                if not osd.store.exists(self.cid, oid):
+                    return True  # deleted meanwhile
+                # a real local read failure must NOT count as pushed —
+                # callers gate `migrated` on the return value; surface
+                # it as the unfound class the callers already handle
+                raise RuntimeError(
+                    f"unreadable local copy of {oid!r}") from None
         osd.perf.inc("recovery_pushes")
         fut = osd.expect_reply(("pushr", self.pgid, s, oid, o))
         await osd.send(
@@ -1781,8 +2009,10 @@ class PG:
         )
         try:
             await asyncio.wait_for(fut, osd.subop_timeout)
+            return True
         except asyncio.TimeoutError:
             osd.drop_reply(("pushr", self.pgid, s, oid, o))
+            return False
 
     async def _reconstruct_chunk(self, oid: bytes, shard: int):
         """Rebuild shard `shard`'s chunk from k survivors (the recovery
@@ -1822,7 +2052,7 @@ class PG:
                         user_attrs.update({
                             k: v for k, v in self.osd.store.getattrs(
                                 cidj, oid
-                            ).items() if k.startswith(USER_ATTR)
+                            ).items() if _is_recovery_attr(k)
                         })
                         progress = True
                     except Exception:
@@ -2160,6 +2390,10 @@ class PG:
         else:
             t.truncate(self.cid, m.oid, 0)
             t.write(self.cid, m.oid, 0, m.data)
+            # wipe first: attrs the pusher DOESN'T have (a stale wh /
+            # ss on our pre-crash copy) must not outlive the install —
+            # the pushed attr set is the complete authoritative state
+            t.rmattrs(self.cid, m.oid)
             t.setattrs(self.cid, m.oid,
                        {**m.attrs, ATTR_V: enc_ver(m.version)})
         if m.last_update > self.log.head:

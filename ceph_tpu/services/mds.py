@@ -39,6 +39,12 @@ from . import fs as fslib
 NOSIZE = 2**64 - 1
 
 EXPIRE_KEY = b"expired_upto"
+#: seq high-water persisted at trim time: once the journal body is
+#: emptied, surviving entries can no longer tell a restarted MDS what
+#: the last allocated seq was — without this header a restart would
+#: reset _seq to 0 and journal new intents at seq <= expired_upto,
+#: which a later crash replay silently skips (round-3 advisor finding)
+SEQ_BASE_KEY = b"seq_base"
 JOURNAL_OID = b"mdslog"
 JOURNAL_TRIM_BYTES = 1 << 20
 
@@ -110,9 +116,18 @@ class MDSLite:
         if self._jbytes > JOURNAL_TRIM_BYTES:
             # opportunistic trim: everything up to self._seq is expired
             # (mutations are single-flight under _lock)
-            await self.client.write_full(self.meta_pool, JOURNAL_OID,
-                                         b"")
-            self._jbytes = 0
+            await self._trim()
+
+    async def _trim(self) -> None:
+        """Empty the journal body (MDLog trim role), preserving the seq
+        high-water in the omap header FIRST — so a crash on either side
+        of the truncation leaves a journal whose replay allocates fresh
+        seqs strictly above expired_upto."""
+        await self.client.omap_set(
+            self.meta_pool, JOURNAL_OID,
+            {SEQ_BASE_KEY: denc.enc_u64(self._seq)})
+        await self.client.write_full(self.meta_pool, JOURNAL_OID, b"")
+        self._jbytes = 0
 
     async def _replay_journal(self) -> None:
         """Crash recovery: re-execute unexpired intents idempotently."""
@@ -124,6 +139,8 @@ class MDSLite:
             omap = await self.client.omap_get(self.meta_pool, JOURNAL_OID)
             expired = denc.dec_u64(omap.get(EXPIRE_KEY,
                                             denc.enc_u64(0)), 0)[0]
+            self._seq = denc.dec_u64(omap.get(SEQ_BASE_KEY,
+                                              denc.enc_u64(0)), 0)[0]
         except KeyError:
             expired = 0
         self._jbytes = len(raw)
@@ -137,10 +154,8 @@ class MDSLite:
             except fslib.FSError:
                 pass  # already applied before the crash: idempotent
             await self._expire(seq)
-        if len(raw) > 1 << 20:  # trim: journal fully expired
-            await self.client.write_full(self.meta_pool, JOURNAL_OID,
-                                         b"")
-            await self._expire(self._seq)
+        if len(raw) > JOURNAL_TRIM_BYTES:  # trim: journal fully expired
+            await self._trim()
 
     # --------------------------------------------------------------- caps
 

@@ -21,6 +21,7 @@ ListBuckets on /, ListObjectsV2 query parameters, XML responses.
 from __future__ import annotations
 
 import asyncio
+import calendar
 import hashlib
 import time
 import urllib.parse
@@ -418,6 +419,12 @@ class S3Frontend:
     authentication when a user table is configured (rgw_auth_s3.h:262
     role; without users the frontend stays open, the DummyAuth tier)."""
 
+    #: max tolerated |request time - server time| before a signed
+    #: request is rejected (RequestTimeTooSkewed) — the reference RGW's
+    #: ~15-minute clock-skew window; without it a captured signed
+    #: request replays forever (round-3 advisor finding)
+    CLOCK_SKEW_S = 900.0
+
     def __init__(self, rgw: RGWLite,
                  users: dict[str, str] | None = None):
         self.rgw = rgw
@@ -425,6 +432,8 @@ class S3Frontend:
         self.users = users or {}
         self._server: asyncio.AbstractServer | None = None
         self.port = 0
+        #: test hook: fake "now" for the skew check (None = wall clock)
+        self._now = None
 
     def _authenticate(self, method: str, target: str, headers: dict,
                       body: bytes) -> str | None:
@@ -448,6 +457,15 @@ class S3Frontend:
         amz_date = headers.get("x-amz-date", "")
         if not amz_date.startswith(date):
             return "SignatureDoesNotMatch"
+        # request freshness: reject timestamps outside the skew window
+        try:
+            ts = calendar.timegm(
+                time.strptime(amz_date, "%Y%m%dT%H%M%SZ"))
+        except ValueError:
+            return "AuthorizationHeaderMalformed"
+        now = self._now if self._now is not None else time.time()
+        if abs(now - ts) > self.CLOCK_SKEW_S:
+            return "RequestTimeTooSkewed"
         # content hash must match the body (payload integrity)
         want_hash = headers.get("x-amz-content-sha256", "")
         if want_hash not in ("UNSIGNED-PAYLOAD", _sha256(body)):

@@ -150,6 +150,47 @@ def test_mds_restart_idempotent_replay():
     run(t())
 
 
+def test_trim_then_restart_preserves_crash_recovery():
+    """Regression (round-3 advisor, high): after a journal trim and an
+    MDS restart, new intents must journal at seqs ABOVE the persisted
+    expired_upto — otherwise a later crash replay skips them and a torn
+    rename persists, exactly the failure the journal exists to prevent."""
+    async def t():
+        c, mds, a, b = await make()
+        await a.mkdir("/d1")
+        await a.mkdir("/d2")
+        await a.create("/d1/f")
+        await a.close()
+        # force a trim: pretend the journal body crossed the threshold
+        mds._jbytes = (1 << 20) + 1
+        await a.connect()
+        await a.mkdir("/junk")  # any journaled mutation triggers _expire
+        assert mds._jbytes == 0  # trimmed
+        await mds.stop()
+
+        # restart: _seq must resume above the pre-trim high-water
+        mds2 = MDSLite(c.bus, c.client, 1)
+        await mds2.start()
+        assert mds2._seq >= mds._seq
+
+        # now crash mid-rename on the restarted daemon; replay must
+        # complete it (would be skipped as "expired" before the fix)
+        mds2._crash_mid_rename = True
+        with pytest.raises(Exception):
+            await b.rename("/d1/f", "/d2/f")
+        await mds2.stop()
+        mds3 = MDSLite(c.bus, c.client, 1)
+        await mds3.start()
+        assert await b.listdir("/d1") == []
+        assert await b.listdir("/d2") == ["f"]
+        await a.close()
+        await b.close()
+        await mds3.stop()
+        await c.stop()
+
+    run(t())
+
+
 def test_dead_client_evicted():
     """A vanished cap holder cannot wedge the namespace: the revoke
     times out and the MDS evicts the cap (session-eviction role)."""
