@@ -13,7 +13,12 @@ daemons (the Crimson stance) around the existing TPU-first kernels:
 - wire: ceph_tpu.msg (CRC-framed typed messages over LocalBus or TCP)
 
 Everything runs equally over the in-process LocalBus (cluster-free test
-tiers, SURVEY §4.2) or TCP (vstart-style multi-process).
+tiers, SURVEY §4.2) or real TCP sockets between OS processes: NetBus
+(msg/netbus.py) gives daemons the same bus contract over one listener
+per process, procstart.ProcCluster launches mon + OSDs as separate
+processes (vstart.sh role), and tests/test_multiprocess.py exercises
+kill -9 of an OSD process, cold-restart durability, and cephx/AES-GCM
+on the wire.
 """
 from .messages import *  # noqa: F401,F403
 from .mon import MonLite  # noqa: F401

@@ -23,10 +23,13 @@ def plan(osdmap, target_per_osd: int = 100,
          max_pg_num: int = 1 << 12) -> list[tuple[int, str, int]]:
     """-> [(pool_id, key, value)] mon mutations for this round.
 
-    Growth only (merge is intentionally out of scope, like the default
-    reference policy until splits are proven); pgp_num catch-up is
-    emitted for pools whose pg_num already grew in a prior round.
-    """
+    Growth: pg_num first (collections split in place), pgp_num catches
+    up the following round so placement moves after the splits landed.
+    Shrink (round-4): the reverse sequence — pgp_num collapses first so
+    children co-locate with their parents, pg_num halves down to it the
+    following round and the OSDs fold collections (PG::merge_from
+    role). Both directions only fire when the ideal is >= THRESHOLD
+    away, so sizes never flap."""
     pools = list(osdmap.pools.values())
     if not pools:
         return []
@@ -36,12 +39,20 @@ def plan(osdmap, target_per_osd: int = 100,
     out: list[tuple[int, str, int]] = []
     budget = target_per_osd * n_up / len(pools)
     for pool in pools:
-        # pgp catch-up first: a previous round's split has landed
-        if pool.pgp_num < pool.pg_num:
-            out.append((pool.id, "pgp_num", pool.pg_num))
-            continue
         size = max(1, pool.size)
-        ideal = _pow2_at_most(min(int(budget / size), max_pg_num))
+        ideal = max(1, _pow2_at_most(min(int(budget / size),
+                                         max_pg_num)))
+        if pool.pgp_num < pool.pg_num:
+            if ideal <= pool.pgp_num:
+                # mid-shrink: placement already collapsed; finish the
+                # merge by halving pg_num down to it
+                out.append((pool.id, "pg_num", pool.pgp_num))
+            else:
+                # mid-split: placement catches up to the grown pg_num
+                out.append((pool.id, "pgp_num", pool.pg_num))
+            continue
         if ideal >= pool.pg_num * THRESHOLD:
             out.append((pool.id, "pg_num", ideal))
+        elif ideal * THRESHOLD <= pool.pg_num:
+            out.append((pool.id, "pgp_num", ideal))
     return out

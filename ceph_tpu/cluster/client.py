@@ -213,12 +213,36 @@ class RadosClient:
                            snapid=NOSNAP if snapid is None else snapid)
             op = _InFlight(msg=msg, fut=asyncio.get_running_loop()
                            .create_future())
-            self._ops[self._tid] = op
+            tid = self._tid
+            self._ops[tid] = op
             op.target = self._calc_target(pgid)
             span.tag("target", op.target)
             if op.target >= 0:
                 await self._send_op(op)
-            reply = await asyncio.wait_for(op.fut, self.op_timeout)
+            # tick-resend while waiting (Objecter op-tracking role): a
+            # message written into a half-dead TCP connection (peer
+            # kill -9, RST not yet seen) is lost silently — the resend
+            # re-dials a fresh connection to the revived daemon
+            loop = asyncio.get_running_loop()
+            deadline = loop.time() + self.op_timeout
+            tick = max(self.op_timeout / 4, 0.5)
+            while True:
+                left = deadline - loop.time()
+                if left <= 0:
+                    self._ops.pop(tid, None)
+                    raise asyncio.TimeoutError(
+                        f"op {tid} ({verb}) timed out")
+                try:
+                    # shield: a tick timeout must NOT cancel the
+                    # pending future (the reply may still arrive)
+                    reply = await asyncio.wait_for(
+                        asyncio.shield(op.fut), min(tick, left))
+                    break
+                except asyncio.TimeoutError:
+                    op.target = self._calc_target(op.msg.pgid)
+                    if op.target >= 0:
+                        op.msg.epoch = self.osdmap.epoch
+                        await self._send_op(op)
             span.tag("result", reply.result)
         return reply
 

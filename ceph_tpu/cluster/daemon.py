@@ -1,0 +1,158 @@
+"""Daemon entry point for multi-process clusters (src/ceph_osd.cc /
+src/ceph_mon.cc main() role).
+
+Runs ONE daemon — a mon (single or paxos rank) or an OSD — as its own
+OS process on a NetBus (msg/netbus.py), with a durable store. Spawned
+by procstart.ProcCluster (the vstart.sh:100-125 launch role) or by
+hand:
+
+    python -m ceph_tpu.cluster.daemon --role osd --id 3 \
+        --book /tmp/cluster/book --store-dir /tmp/cluster \
+        --n-osds 4 --objectstore walstore
+
+A keyring file ``keyring`` in the book dir (lines ``entity hexsecret``)
+switches every connection to the cephx-role authenticated mode; pass
+--secure for AES-GCM on the wire.
+
+SIGTERM stops cleanly; kill -9 is the crash the stores and the rest of
+the cluster are built to survive.
+"""
+from __future__ import annotations
+
+import argparse
+import asyncio
+import os
+import signal
+import sys
+
+
+def load_keyring(book_dir: str):
+    """keyring file -> KeyServer | None (CephxKeyServer role)."""
+    path = os.path.join(book_dir, "keyring")
+    if not os.path.exists(path):
+        return None
+    from ..msg.auth import KeyServer
+
+    ks = KeyServer()
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            entity, hexsecret = line.split()
+            ks.add(entity, bytes.fromhex(hexsecret))
+    return ks
+
+
+def make_keyring(book_dir: str, entities) -> None:
+    """Generate a shared keyring for a dev cluster (vstart auth role)."""
+    import secrets
+
+    path = os.path.join(book_dir, "keyring")
+    with open(path, "w") as f:
+        for e in entities:
+            f.write(f"{e} {secrets.token_hex(32)}\n")
+
+
+async def _amain(args) -> None:
+    from ..msg.netbus import NetBus
+    from .. import store as store_mod
+
+    keys = load_keyring(args.book)
+    bus = NetBus(args.book, keys=keys, secure=args.secure)
+    await bus.start()
+
+    stop_ev = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        loop.add_signal_handler(sig, stop_ev.set)
+
+    if args.role == "mon":
+        from .monstore import MonStore
+
+        store = MonStore(os.path.join(args.store_dir,
+                                      f"mon.{args.id}.kv"))
+        if args.n_mons > 1:
+            from .paxos_mon import PaxosMon
+
+            daemon = PaxosMon(bus, args.n_osds, rank=args.id,
+                              n_mons=args.n_mons, store=store,
+                              hb_grace=args.hb_grace,
+                              out_interval=args.out_interval)
+        else:
+            from .mon import MonLite
+
+            daemon = MonLite(bus, args.n_osds, store=store,
+                             hb_grace=args.hb_grace,
+                             out_interval=args.out_interval)
+    elif args.role == "osd":
+        from .osd import OSDLite
+
+        store = store_mod.create(
+            args.objectstore,
+            os.path.join(args.store_dir, f"osd.{args.id}"))
+        daemon = OSDLite(bus, args.id, store=store,
+                         hb_interval=args.hb_interval)
+    else:
+        raise SystemExit(f"unknown role {args.role!r}")
+
+    await daemon.start()
+    if hasattr(daemon, "start_admin"):
+        # `ceph daemon <name> <cmd>` surface, one socket per daemon
+        await daemon.start_admin(os.path.join(
+            args.store_dir, f"{args.role}.{args.id}.asok"))
+    # readiness marker for the launcher (systemd-notify role)
+    ready = os.path.join(args.book, f"{args.role}.{args.id}.ready")
+    with open(ready, "w") as f:
+        f.write(str(os.getpid()))
+
+    async def watch_parent() -> None:
+        # exit with the launcher: a dev-cluster daemon orphaned by a
+        # killed test run must not linger and cross-talk with the next
+        # cluster sharing the same book paths
+        ppid = os.getppid()
+        while os.getppid() == ppid:
+            await asyncio.sleep(0.5)
+        stop_ev.set()
+
+    parent_task = loop.create_task(watch_parent())
+    try:
+        await stop_ev.wait()
+        parent_task.cancel()
+    finally:
+        try:
+            await asyncio.wait_for(daemon.stop(), 5)
+        except Exception:
+            pass
+        await bus.close()
+        try:
+            os.unlink(ready)
+        except OSError:
+            pass
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(prog="ceph-tpu-daemon")
+    ap.add_argument("--role", required=True, choices=["mon", "osd"])
+    ap.add_argument("--id", type=int, default=0,
+                    help="osd id / mon rank")
+    ap.add_argument("--book", required=True,
+                    help="shared address-book directory")
+    ap.add_argument("--store-dir", required=True)
+    ap.add_argument("--n-osds", type=int, required=True)
+    ap.add_argument("--n-mons", type=int, default=1)
+    ap.add_argument("--objectstore", default="walstore")
+    ap.add_argument("--secure", action="store_true",
+                    help="AES-GCM on-wire (needs a keyring)")
+    ap.add_argument("--hb-interval", type=float, default=0.15)
+    ap.add_argument("--hb-grace", type=float, default=2.0)
+    ap.add_argument("--out-interval", type=float, default=4.0)
+    args = ap.parse_args(argv)
+    try:
+        asyncio.run(_amain(args))
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

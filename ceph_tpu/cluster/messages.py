@@ -651,3 +651,19 @@ class MEnvelope(Message):
         ("mtype", "u32"),
         ("payload", "bytes"),
     )
+
+
+@register_message
+class MBackfillReserve(Message):
+    """Remote backfill-slot protocol (MBackfillReserve role): a primary
+    asks a recovery TARGET for an inbound slot before pushing; the
+    target grants when its remote reserver has room and the primary
+    releases when the pushes land. op: request | grant | release."""
+    TYPE = 91
+    FIELDS = (
+        ("pgid", PGID),
+        ("op", "str"),
+        ("osd", "u32"),  # sender's osd id
+        ("prio", "i32"),
+    )
+    DEFAULTS = {"prio": 0}

@@ -306,14 +306,25 @@ class MonLite:
         async with self._pool_mut_lock:
             pool = copy.deepcopy(self.osdmap.pools[msg.pool_id])
             if msg.key == "pg_num":
-                if (val < pool.pg_num or not _pow2(val)
-                        or not _pow2(pool.pg_num)
+                if (not _pow2(val) or not _pow2(pool.pg_num)
                         or val > MAX_POOL_PG_NUM):
                     await reply(-22)
                     return
+                if val < pool.pg_num:
+                    # merge preconditions (the pg_num_pending role):
+                    # children must already be CO-LOCATED with their
+                    # parents — pgp_num collapses first, placement
+                    # converges (every pg_temp pin cleared, i.e. the
+                    # data actually moved), then pg_num halves fold
+                    # collections in lockstep
+                    if val < pool.pgp_num or any(
+                            pg[0] == pool.id for pg in self.osdmap.pg_temp):
+                        await reply(-11)  # EAGAIN: not clean yet, retry
+                        return
                 pool.pg_num = val
             elif msg.key == "pgp_num":
-                if val < pool.pgp_num or val > pool.pg_num:
+                if (val > pool.pg_num or val < 1
+                        or (val < pool.pgp_num and not _pow2(val))):
                     await reply(-22)
                     return
                 pool.pgp_num = val

@@ -142,6 +142,19 @@ class OSDLite:
         self.fault = FaultInjector()
         self.perf = PerfCounters(self.name)
         self._declare_counters()
+        # recovery/backfill concurrency bounds (AsyncReserver role,
+        # src/common/AsyncReserver.h + osd_max_backfills): LOCAL slots
+        # gate this OSD's own recovery work as a primary; REMOTE slots
+        # gate the inbound backfills it serves as a target
+        from .reserver import AsyncReserver
+
+        nbf = self.conf["osd_max_backfills"]
+        self.local_reserver = AsyncReserver(nbf)
+        self.remote_reserver = AsyncReserver(nbf)
+        self.conf.observe(
+            "osd_max_backfills",
+            lambda _n, v: (self.local_reserver.set_max(v),
+                           self.remote_reserver.set_max(v)))
         self.ec_batcher = ECBatcher(self.perf)
         self.admin: AdminSocket | None = None
         # QoS between client / recovery / scrub traffic (mClock role)
@@ -179,6 +192,7 @@ class OSDLite:
         p.add_u64_counter("scrubs", "scrub rounds executed")
         p.add_u64_counter("snap_trims", "objects snap-trimmed")
         p.add_u64_counter("pg_splits", "child PGs split from parents")
+        p.add_u64_counter("pg_merges", "child PGs merged into parents")
         p.add_u64_counter("map_epochs", "osdmap epochs consumed")
 
     # ----------------------------------------------------------- plumbing
@@ -445,6 +459,8 @@ class OSDLite:
             self.op_scheduler.enqueue(
                 RECOVERY, lambda: pg.handle_pull(src, msg)
             )
+        elif isinstance(msg, M.MBackfillReserve):
+            await self._handle_backfill_reserve(src, msg)
         elif isinstance(msg, M.MPGScan):
             pg = self._ensure_pg(msg.pgid, msg.shard)
             self.op_scheduler.enqueue(
@@ -573,9 +589,18 @@ class OSDLite:
         if pg is None:
             self._maybe_split(pgid, shard)
             pg = PG(self, pgid, shard)
-            if self.osdmap is not None and pgid[0] in self.osdmap.pools:
+            pool = (self.osdmap.pools.get(pgid[0])
+                    if self.osdmap is not None else None)
+            if pool is not None:
                 pg.acting, pg.primary = \
                     self.osdmap.pg_to_up_acting_osds(pgid)
+            if pool is not None and pgid[1] >= pool.pg_num:
+                # a stale in-flight message for a MERGED-away child:
+                # hand back a transient instance so the handler can
+                # bounce ESTALE, but never register it — a zombie in
+                # self.pgs would sit in 'peering' forever and wedge
+                # every wait-for-clean
+                return pg
             self.pgs[key] = pg
         return pg
 
@@ -625,6 +650,73 @@ class OSDLite:
                 self.store.queue_transaction(t)
                 self.perf.inc("pg_splits")
 
+    def _merge_pool_children(self, pool, prev_pg_num: int) -> None:
+        """PG merge on a pg_num shrink (PG::merge_from role,
+        src/osd/PG.cc:571): every child in [new, prev) folds back into
+        its parent (child & (new-1)) wherever this OSD holds either
+        side. The mon only shrinks pg_num after pgp_num collapsed, so
+        parent and child are co-located and every member merges the
+        same pair in lockstep at the same map transition.
+
+        The merged PG restarts with a FRESH log anchored at
+        (merge_epoch, 0) — identical on every member by construction —
+        which forces the merged PG through a new interval the way the
+        reference does; a member that missed the transition (revived
+        later) anchors BELOW that tail and backfills from the merged
+        survivors. Merge assumes clean PGs (the autoscaler, like the
+        reference's pg_num_pending machinery, only shrinks healthy
+        pools)."""
+        from .pg import META_OID
+        from .pglog import PGLog
+
+        n = pool.pg_num
+        if n & (n - 1) or prev_pg_num & (prev_pg_num - 1):
+            return  # merges only defined between pow2 pg_num values
+        epoch = self.osdmap.epoch
+        colls = set(self.store.list_collections())
+        prefix = f"{pool.id}."
+        merged_parents: set[str] = set()
+        for c in range(n, prev_pg_num):
+            p = c & (n - 1)
+            for ccid in sorted(colls):
+                if not ccid.startswith(prefix):
+                    continue
+                body = ccid[len(prefix):]
+                ps_s, _, suffix = body.partition("s")
+                if int(ps_s) != c:
+                    continue
+                pcid = f"{prefix}{p}" + (f"s{suffix}" if suffix else "")
+                t = tx_mod.Transaction()
+                if pcid not in colls:
+                    t.create_collection(pcid)
+                    colls.add(pcid)
+                # the child's log object must not clobber the parent's
+                # (a stray child pushed object-by-object may lack one)
+                if self.store.exists(ccid, META_OID):
+                    t.remove(ccid, META_OID)
+                t.merge_collection(ccid, pcid)
+                merged = PGLog()
+                merged.tail = (epoch, 0)
+                t.truncate(pcid, META_OID, 0)
+                t.write(pcid, META_OID, 0, merged.encode())
+                self.store.queue_transaction(t)
+                colls.discard(ccid)
+                merged_parents.add(pcid)
+                self.perf.inc("pg_merges")
+        # drop in-memory instances: children are gone from the map, and
+        # merged parents must reload their fresh on-disk log; peering
+        # under the new map re-activates them
+        for key in list(self.pgs):
+            if key[0] != pool.id:
+                continue
+            suffix = f"s{key[2]}" if key[2] >= 0 else ""
+            cid = f"{prefix}{key[1]}{suffix}"
+            if key[1] >= n or cid in merged_parents:
+                pg = self.pgs.pop(key)
+                for task in (pg._peer_task, pg._migrate_task):
+                    if task is not None:
+                        task.cancel()
+
     def _maybe_split(self, pgid, shard: int) -> None:
         """Lazy split fallback for members that missed the pg_num
         transition (revived mid-history): move the child's objects out
@@ -667,6 +759,30 @@ class OSDLite:
 
     # ----------------------------------------------------------- map flow
 
+    async def _handle_backfill_reserve(self, src: str,
+                                       msg: M.MBackfillReserve) -> None:
+        """Target side of the remote backfill-slot protocol: grant when
+        the remote reserver has room, release frees the slot. The
+        grant may queue behind other inbound backfills — that queueing
+        IS the bound (osd_max_backfills on the target)."""
+        key = ("remote", tuple(msg.pgid), msg.osd)
+        if msg.op == "request":
+            async def _grant():
+                await self.remote_reserver.request(key, msg.prio)
+                try:
+                    await self.send(
+                        f"osd.{msg.osd}",
+                        M.MBackfillReserve(pgid=msg.pgid, op="grant",
+                                           osd=self.id))
+                except Exception:
+                    self.remote_reserver.release(key)
+            self.spawn(_grant())
+        elif msg.op == "release":
+            self.remote_reserver.release(key)
+        elif msg.op == "grant":
+            # primary side: wake the reservation waiter
+            self._resolve(("bfgrant", tuple(msg.pgid), msg.osd), msg)
+
     async def _handle_map(self, msg: M.MOSDMapMsg) -> None:
         if msg.full:
             m, _ = menc.decode_osdmap(msg.full)
@@ -693,6 +809,8 @@ class OSDLite:
             prev = self._pool_pg_num.get(pool.id, pool.pg_num)
             if pool.pg_num > prev:
                 self._split_pool_children(pool, prev)
+            elif pool.pg_num < prev:
+                self._merge_pool_children(pool, prev)
             self._pool_pg_num[pool.id] = pool.pg_num
         self._scan_pgs()
         self._kick_snap_trim()

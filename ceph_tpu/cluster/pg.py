@@ -993,18 +993,17 @@ class PG:
         ack on all-commit."""
         peers = [(o, s) for o, s in self.live_members()
                  if o != self.osd.id]
-        peers += [(o, s) for o, s in extras if o != self.osd.id]
+        extra_peers = [(o, s) for o, s in extras if o != self.osd.id]
         local = tx.Transaction()
         self._ensure_coll(local)
         local.ops.extend(self._filter_remote_ops(mut))
         self._append_and_persist(entries, local)
         self.osd.store.queue_transaction(local)
         enc_txn = mut.encode()
-        waits = []
-        for o, _s in peers:
+
+        async def _ship(o: int):
             subtid = self.osd.new_subtid()
             fut = self.osd.expect_reply(subtid)
-            waits.append((o, subtid, fut))
             await self.osd.send(
                 f"osd.{o}",
                 M.MOSDRepOp(tid=subtid, pgid=self.pgid, txn=enc_txn,
@@ -1013,14 +1012,39 @@ class PG:
                             prev_head=self.acked_head,
                             trace=_trace_ctx()),
             )
+            return (o, subtid, fut)
+
+        waits = [await _ship(o) for o, _s in peers]
+        extra_waits = [await _ship(o) for o, _s in extra_peers]
         try:
             await self.osd.gather(waits)
         except BaseException:
             self._mig_fanout_done(entries[-1].oid, ok=False)
+            self._repeer_on_subop_failure()
             raise
-        self._mig_fanout_done(entries[-1].oid, ok=True)
+        # ACTING all-acked: the op succeeds and the fence head advances
+        # regardless of the extras — migration targets are best-effort
+        # (the reference's backfill targets never fail client IO); a
+        # bounced/lost extra delta just demotes the oid for re-push
         if entries[-1].version > self.acked_head:
             self.acked_head = entries[-1].version
+        await self._gather_extras(entries[-1].oid, extra_waits)
+
+    async def _gather_extras(self, oid: bytes, extra_waits) -> None:
+        ok = True
+        for o, subtid, fut in extra_waits:
+            try:
+                reply = await asyncio.wait_for(fut,
+                                               self.osd.subop_timeout)
+                ok &= (reply.result == M.OK)
+            except (asyncio.TimeoutError, Exception):
+                self.osd.drop_reply(subtid)
+                ok = False
+        self._mig_fanout_done(oid, ok=ok)
+        if not ok and oid in self.migrated:
+            # a failed delta left some extra behind: its base is stale
+            self.migrated.discard(oid)
+            self.mig_dirty.add(oid)
 
     # -------------------------------------------------------- EC backend
 
@@ -1223,15 +1247,16 @@ class PG:
         osd = self.osd
         version = entries[-1].version
         waits = []
+        extra_waits = []
         for pos, t in shard_txns.items():
             targets = []
             if live.get(pos) is not None:
-                targets.append(live[pos])
-            targets += [o for o, p in extras if p == pos]
+                targets.append((live[pos], False))
+            targets += [(o, True) for o, p in extras if p == pos]
             if not targets:
                 continue  # degraded write: the hole recovers via peering
             hp = hpatch[pos] if isinstance(hpatch, dict) else hpatch
-            for target in targets:
+            for target, is_extra in targets:
                 if target == osd.id:
                     self._apply_shard_write(self._shard_cid(pos), t,
                                             entries, hp, ncells, size,
@@ -1239,7 +1264,8 @@ class PG:
                     continue
                 subtid = osd.new_subtid()
                 fut = osd.expect_reply(subtid)
-                waits.append((target, subtid, fut))
+                (extra_waits if is_extra else waits).append(
+                    (target, subtid, fut))
                 await osd.send(
                     f"osd.{target}",
                     M.MECSubWrite(tid=subtid, pgid=self.pgid, shard=pos,
@@ -1254,10 +1280,26 @@ class PG:
             await osd.gather(waits)
         except BaseException:
             self._mig_fanout_done(oid, ok=False)
+            self._repeer_on_subop_failure()
             raise
-        self._mig_fanout_done(oid, ok=True)
+        # see _rep_fanout: acting all-acked; extras are best-effort
         if version > self.acked_head:
             self.acked_head = version
+        await self._gather_extras(oid, extra_waits)
+
+    def _repeer_on_subop_failure(self) -> None:
+        """An acting member failed/bounced a sub-write: something is
+        inconsistent (a fenced stale log, a member that lost its base,
+        a vanished peer). Re-run peering — the reference primary
+        restarts its PeeringMachine when a repop errors the same way;
+        the failed op EAGAINs to the client and retries after the
+        round repaired (or consciously skipped) the member."""
+        if self.is_primary() and self.state == "active":
+            self.state = "peering"
+            if self._peer_task is None or self._peer_task.done():
+                self._peer_task = (
+                    asyncio.get_running_loop().create_task(
+                        self._peer_and_recover()))
 
     def _apply_shard_write(self, cid: str, t: tx.Transaction,
                            entries: list[Entry], hpatch: bytes,
@@ -1697,31 +1739,79 @@ class PG:
         best_key = max(infos, key=lambda k: infos[k].last_update)
         best = infos[best_key]
 
-        # -- recover self to authoritative
-        if best.last_update > self.log.head:
-            await self._recover_self(best_key, best)
+        # which members actually need recovery work? slot-free fast
+        # path when everyone already agrees (the common map-churn case)
+        target_head = best.last_update
+        lagging = [(o, s) for (o, s), i in infos.items()
+                   if i.last_update != target_head]
+        reserved_remote: list[int] = []
+        held_local = False
+        try:
+            if lagging:
+                # LOCAL backfill slot (AsyncReserver role): bounds how
+                # many of this OSD's PGs recover at once so a mass
+                # remap cannot stampede. The timeout breaks reservation
+                # deadlock cycles — the round just retries.
+                try:
+                    await asyncio.wait_for(
+                        osd.local_reserver.request(("pg", self.pgid)),
+                        osd.subop_timeout * 8)
+                except asyncio.TimeoutError:
+                    osd.local_reserver.release(("pg", self.pgid))
+                    return False
+                held_local = True
+                if osd.osdmap.epoch != epoch:
+                    return False
 
-        # -- recover peers (delta or backfill)
-        for (o, s), info in infos.items():
-            if o == osd.id:
-                continue
-            missing = self.log.missing_after(info.last_update)
-            if missing is None:
-                await self._backfill_peer(o, s)
-            else:
-                for oid, e in missing.items():
-                    if self._subop_misdirected(oid):
-                        continue  # split stray: lives in a child PG now
-                    try:
-                        await self._push_object(o, s, oid, e)
-                    except RuntimeError:
-                        # unreconstructable (e.g. the log entry of a
-                        # bounced degraded write that never reached k
-                        # shards): the client's retry re-created the
-                        # object wherever it maps now — do NOT wedge
-                        # peering forever on it (unfound-object role)
-                        osd.perf.inc("recovery_unfound")
-                        osd.log_exc(f"pg {self.pgid} unfound {oid!r}")
+            # -- recover self to authoritative
+            if best.last_update > self.log.head:
+                await self._recover_self(best_key, best)
+
+            # -- recover peers (delta or backfill), a REMOTE slot on
+            # each target bounding its inbound backfills
+            for (o, s), info in infos.items():
+                if o == osd.id or info.last_update == self.log.head:
+                    continue
+                if not await self._reserve_remote(o):
+                    return False  # target saturated: retry the round
+                reserved_remote.append(o)
+                missing = self.log.missing_after(info.last_update)
+                if missing is None:
+                    await self._backfill_peer(o, s)
+                else:
+                    for oid, e in missing.items():
+                        if self._subop_misdirected(oid):
+                            continue  # split stray: child PG owns it
+                        try:
+                            await self._push_object(o, s, oid, e)
+                        except RuntimeError:
+                            # unreconstructable (e.g. the log entry of
+                            # a bounced degraded write that never
+                            # reached k shards): the client's retry
+                            # re-created the object wherever it maps
+                            # now — do NOT wedge peering forever on it
+                            # (unfound-object role)
+                            osd.perf.inc("recovery_unfound")
+                            osd.log_exc(
+                                f"pg {self.pgid} unfound {oid!r}")
+                # converge the peer's LOG POSITION unconditionally:
+                # when every push above was skipped (split strays,
+                # unfound debris), no message carried our last_update,
+                # and a peer left behind would fence every subsequent
+                # sub-write against the activation-seeded acked_head —
+                # a permanent livelock (round-4 EC-split finding)
+                await self._push_log_head(o, s)
+        finally:
+            if held_local:
+                osd.local_reserver.release(("pg", self.pgid))
+            for o in reserved_remote:
+                try:
+                    await osd.send(
+                        f"osd.{o}",
+                        M.MBackfillReserve(pgid=self.pgid, op="release",
+                                           osd=osd.id))
+                except Exception:
+                    pass
 
         if osd.osdmap.epoch != epoch:
             return False
@@ -1750,6 +1840,12 @@ class PG:
             self.mig_dirty.clear()
             self.mig_fresh.clear()
             self._mig_extras = frozenset()
+            if tuple(self.pgid) in self.osd.osdmap.pg_temp:
+                # pinned to a set IDENTICAL to up (re-placement landed
+                # on the same members): nothing to move, but the pin
+                # must still drop or the pool never reads as clean
+                self.osd.spawn(
+                    self.osd.mon_send(M.MPGTempClear(pgid=self.pgid)))
             return
         if extras != self._mig_extras:
             # membership changed: `migrated` was earned against the OLD
@@ -1776,11 +1872,22 @@ class PG:
         bases). MPGTempClear is only sent once every oid converged."""
         osd = self.osd
         try:
+            # migration pushes are backfill-class work: take a LOCAL
+            # slot so a pgp change remapping many PGs migrates at most
+            # osd_max_backfills of them at once (client IO on the
+            # still-pinned acting sets keeps flowing meanwhile)
+            await osd.local_reserver.request(("mig", self.pgid))
             spins = 0
             last_extras: frozenset = frozenset()
             #: oids this run decided not to migrate (split strays,
             #: unfound) — excluded from re-listing or they spin the loop
             skipped: set[bytes] = set()
+            #: per-oid reconstruction-failure budget: transient survivor
+            #: outages heal within it (mark-down changes the extras and
+            #: restarts bookkeeping anyway); what remains is the debris
+            #: of never-acked partial writes, which must not block the
+            #: handoff forever (unfound role)
+            fail_budget: dict[bytes, int] = {}
             while True:
                 if not self.is_primary() or self.state != "active":
                     return  # superseded; the next primary restarts
@@ -1850,14 +1957,21 @@ class PG:
                                     force=False)
                     except RuntimeError:
                         # push/reconstruction failure. Usually transient
-                        # (a survivor shard briefly unreachable): RETRY,
-                        # holding the pin — handing off while the only
-                        # healthy copies are on the acting set would be
-                        # irreversible. Split strays are the permanent
-                        # case and were skipped above.
+                        # (a survivor shard briefly unreachable): RETRY
+                        # while holding the pin — but only within a
+                        # budget: an object that NEVER reconstructs is
+                        # the debris of an unacked partial write (the
+                        # client saw a failure), and it must not block
+                        # the handoff forever.
                         osd.perf.inc("recovery_unfound")
-                        osd.log_exc(f"pg {self.pgid} unpushable {oid!r}")
-                        retry.append(oid)
+                        left = fail_budget.get(oid, 15) - 1
+                        fail_budget[oid] = left
+                        if left <= 0:
+                            osd.log_exc(
+                                f"pg {self.pgid} unfound {oid!r}")
+                            skipped.add(oid)
+                        else:
+                            retry.append(oid)
                         continue
                     # atomic wrt the reactor: no await between the
                     # dirty/version check and migrated.add
@@ -1880,6 +1994,34 @@ class PG:
             raise
         except Exception:
             osd.log_exc(f"pg {self.pgid} up-migration")
+        finally:
+            osd.local_reserver.release(("mig", self.pgid))
+
+    async def _reserve_remote(self, o: int) -> bool:
+        """Ask recovery target osd.o for an inbound backfill slot
+        (MBackfillReserve request/grant); False on timeout — the
+        peering round retries, and the bounded wait breaks reservation
+        deadlock cycles between mutually-backfilling OSDs."""
+        osd = self.osd
+        key = ("bfgrant", self.pgid, o)
+        fut = osd.expect_reply(key)
+        try:
+            await osd.send(
+                f"osd.{o}",
+                M.MBackfillReserve(pgid=self.pgid, op="request",
+                                   osd=osd.id))
+            await asyncio.wait_for(fut, osd.subop_timeout * 4)
+            return True
+        except (asyncio.TimeoutError, Exception):
+            osd.drop_reply(key)
+            try:  # cancel the queued request on the target
+                await osd.send(
+                    f"osd.{o}",
+                    M.MBackfillReserve(pgid=self.pgid, op="release",
+                                       osd=osd.id))
+            except Exception:
+                pass
+            return False
 
     async def _recover_self(self, best_key, best: PGInfo) -> None:
         """Repair our own copy, THEN adopt the authoritative log: pull
@@ -1972,6 +2114,17 @@ class PG:
             except RuntimeError:
                 self.osd.perf.inc("recovery_unfound")
                 self.osd.log_exc(f"pg {self.pgid} unfound {oid!r}")
+        await self._push_log_head(o, s)  # see _do_peering
+
+    async def _push_log_head(self, o: int, s: int) -> None:
+        """Ship ONLY our log position to a peer (a content-free delete
+        push of an empty oid): handle_push adopts last_update, so the
+        peer's head converges even when every object push was skipped."""
+        try:
+            await self._push_object(o, s, b"",
+                                    Entry(OP_DELETE, b"", self.log.head))
+        except Exception:
+            pass  # best-effort; the next round retries
 
     async def _push_object(self, o: int, s: int, oid: bytes,
                            e: Entry, force: bool = True) -> bool:

@@ -12,6 +12,24 @@ authoritative-log selection reduces to "max last_update wins" and peer
 logs are always prefixes of the authoritative log when tails allow delta
 recovery. The reference's divergent-entry machinery (PGLog.cc
 _merge_divergent_entries) guards asynchronous ack modes we do not have.
+
+The prefix-shape invariant, precisely (round-4, tested by
+test_cluster.py::test_primary_crash_mid_fanout_survivors_converge):
+
+1. Entries a primary fanned out but never all-acked (a crash mid
+   fan-out leaves them on a strict subset of members) are UNACKED —
+   the client never saw success, so either surviving outcome is legal,
+   but all survivors must converge to ONE of them.
+2. Convergence holds because authoritative selection takes the max
+   last_update among the NEW interval's members: a survivor holding
+   the unacked entry becomes (or feeds) the authority and the entry
+   completes everywhere; if no survivor holds it, it never existed.
+3. Members never append over a gap of ALL-ACKED history: sub-ops carry
+   the primary's acked head and are fenced below it (pg.py
+   _subop_fenced), so a revived stale member cannot fake currency —
+   it must recover through peering. Same-interval unacked gaps are
+   absorbed by design (the client retry re-applies the content under
+   a fresh version).
 """
 from __future__ import annotations
 

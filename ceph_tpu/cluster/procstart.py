@@ -1,0 +1,217 @@
+"""ProcCluster: a REAL multi-process dev cluster (src/vstart.sh:100-125
+role) — mon(s) + N OSDs as separate OS processes over TCP (NetBus),
+durable stores, optional cephx/secure wire, and the qa-tier chaos verbs
+(kill -9 an OSD process, revive it, watch the cluster heal).
+
+The test process hosts the RadosClient and a lightweight mgr-report
+sink on the same NetBus, so the TestCluster wait helpers keep their
+shape: ``wait_down`` reads the client's map, ``wait_active`` reads the
+OSDs' own MMgrReport state counts.
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+from ..msg.netbus import NetBus
+from . import messages as M
+from .client import RadosClient
+from .daemon import load_keyring, make_keyring
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+
+class ProcCluster:
+    def __init__(self, data_dir: str, n_osds: int = 3, n_mons: int = 1,
+                 objectstore: str = "walstore", auth: bool = False,
+                 secure: bool = False, spawn_timeout: float = 30.0):
+        self.data_dir = data_dir
+        self.book = os.path.join(data_dir, "book")
+        self.n_osds = n_osds
+        self.n_mons = n_mons
+        self.objectstore = objectstore
+        self.secure = secure
+        self.spawn_timeout = spawn_timeout
+        os.makedirs(self.book, exist_ok=True)
+        if auth or secure:
+            entities = (["mon"]
+                        + [f"mon.{r}" for r in range(n_mons)]
+                        + [f"osd.{i}" for i in range(n_osds)]
+                        + [f"client.{i}" for i in range(4)]
+                        + ["node"])
+            # NetBus authenticates at PROCESS level (node.<pid>): every
+            # node shares one node key; entity keys cover the future
+            # per-entity caps story
+            make_keyring(self.book, entities)
+        self.procs: dict[str, subprocess.Popen | None] = {}
+        self.bus: NetBus | None = None
+        self.client: RadosClient | None = None
+        #: mgr-report sink: osd -> {"epoch": int, "pgs": {state: n}}
+        self.reports: dict[int, dict] = {}
+
+    # ----------------------------------------------------------- lifecycle
+
+    def _spawn(self, role: str, ident: int) -> subprocess.Popen:
+        ready = os.path.join(self.book, f"{role}.{ident}.ready")
+        try:
+            os.unlink(ready)
+        except OSError:
+            pass
+        env = dict(os.environ)
+        env["PYTHONPATH"] = _REPO_ROOT + os.pathsep + env.get(
+            "PYTHONPATH", "")
+        # daemons never need the real chip; CPU jax keeps spawns light
+        # and leaves the tunnel device to the test process
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        args = [
+            sys.executable, "-m", "ceph_tpu.cluster.daemon",
+            "--role", role, "--id", str(ident),
+            "--book", self.book, "--store-dir", self.data_dir,
+            "--n-osds", str(self.n_osds),
+            "--n-mons", str(self.n_mons),
+            "--objectstore", self.objectstore,
+        ]
+        if self.secure:
+            args.append("--secure")
+        log = open(os.path.join(self.data_dir,
+                                f"{role}.{ident}.log"), "ab")
+        proc = subprocess.Popen(args, env=env, stdout=log, stderr=log)
+        self.procs[f"{role}.{ident}"] = proc
+        return proc
+
+    async def _wait_ready(self, role: str, ident: int) -> None:
+        ready = os.path.join(self.book, f"{role}.{ident}.ready")
+        deadline = time.monotonic() + self.spawn_timeout
+        while not os.path.exists(ready):
+            proc = self.procs[f"{role}.{ident}"]
+            if proc is not None and proc.poll() is not None:
+                raise RuntimeError(
+                    f"{role}.{ident} exited rc={proc.returncode} "
+                    f"(see {self.data_dir}/{role}.{ident}.log)")
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"{role}.{ident} never became ready")
+            await asyncio.sleep(0.05)
+
+    async def start(self) -> None:
+        for r in range(self.n_mons):
+            self._spawn("mon", r)
+        for r in range(self.n_mons):
+            await self._wait_ready("mon", r)
+        for i in range(self.n_osds):
+            self._spawn("osd", i)
+        for i in range(self.n_osds):
+            await self._wait_ready("osd", i)
+        self.bus = NetBus(self.book, keys=load_keyring(self.book),
+                          secure=self.secure)
+        await self.bus.start()
+        self.bus.register("mgr", self._mgr_sink)
+        self.client = RadosClient(self.bus)
+        await self.client.connect()
+
+    async def _mgr_sink(self, _src: str, msg) -> None:
+        if isinstance(msg, M.MMgrReport):
+            self.reports[msg.osd] = {
+                "ts": time.time(), "epoch": msg.epoch,
+                "pgs": dict(msg.pgs),
+                "perf": json.loads(msg.perf.decode() or "{}"),
+            }
+
+    async def stop(self) -> None:
+        if self.client is not None:
+            try:
+                await self.client.close()
+            except Exception:
+                pass
+        for name, proc in self.procs.items():
+            if proc is not None and proc.poll() is None:
+                proc.terminate()
+        deadline = time.monotonic() + 10
+        for name, proc in self.procs.items():
+            if proc is None:
+                continue
+            while proc.poll() is None and time.monotonic() < deadline:
+                await asyncio.sleep(0.05)
+            if proc.poll() is None:
+                proc.kill()
+        if self.bus is not None:
+            await self.bus.close()
+
+    # ------------------------------------------------------------- chaos
+
+    def kill_osd(self, i: int, sig: int = signal.SIGKILL) -> None:
+        """Crash-stop the OSD *process* (OSDThrasher kill_osd role —
+        kill -9, no goodbye; the mon notices by heartbeat timeout)."""
+        proc = self.procs.get(f"osd.{i}")
+        assert proc is not None and proc.poll() is None, f"osd.{i} gone"
+        proc.send_signal(sig)
+        proc.wait()
+        self.procs[f"osd.{i}"] = None
+        self.reports.pop(i, None)
+
+    async def revive_osd(self, i: int) -> None:
+        self._spawn("osd", i)
+        await self._wait_ready("osd", i)
+
+    def kill_mon(self, rank: int, sig: int = signal.SIGKILL) -> None:
+        proc = self.procs.get(f"mon.{rank}")
+        assert proc is not None and proc.poll() is None
+        proc.send_signal(sig)
+        proc.wait()
+        self.procs[f"mon.{rank}"] = None
+
+    # -------------------------------------------------------- wait helpers
+
+    async def _refresh_map(self) -> None:
+        try:
+            await self.client._mon_send(
+                M.MMonGetMap(have=0), deadline_s=0.5)
+        except Exception:
+            pass
+
+    async def wait_down(self, osd_id: int, timeout: float = 30.0) -> None:
+        async def _wait():
+            while True:
+                await self._refresh_map()
+                m = self.client.osdmap
+                if m is not None and not m.osds[osd_id].up:
+                    return
+                await asyncio.sleep(0.1)
+        await asyncio.wait_for(_wait(), timeout)
+
+    async def wait_up(self, osd_id: int, timeout: float = 30.0) -> None:
+        async def _wait():
+            while True:
+                await self._refresh_map()
+                m = self.client.osdmap
+                if m is not None and m.osds[osd_id].up:
+                    return
+                await asyncio.sleep(0.1)
+        await asyncio.wait_for(_wait(), timeout)
+
+    async def wait_active(self, timeout: float = 30.0) -> None:
+        """Every live OSD reports all its PGs active on the current
+        epoch (the wait-for-clean role, via the OSDs' own MMgrReport)."""
+        live = [i for i in range(self.n_osds)
+                if self.procs.get(f"osd.{i}") is not None]
+
+        async def _wait():
+            while True:
+                await self._refresh_map()
+                m = self.client.osdmap
+                now = time.time()
+                if m is not None and all(
+                    (rep := self.reports.get(i)) is not None
+                    and now - rep["ts"] < 2.0
+                    and rep["epoch"] == m.epoch
+                    and all(s == "active" for s in rep["pgs"])
+                    for i in live
+                ):
+                    return
+                await asyncio.sleep(0.1)
+        await asyncio.wait_for(_wait(), timeout)

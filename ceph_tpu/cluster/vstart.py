@@ -177,6 +177,17 @@ class TestCluster:
         assert pg is not None
         return await pg.scrub()
 
+    async def wait_clean(self, timeout: float = 30.0) -> None:
+        """wait_active AND every pg_temp pin cleared (the data of any
+        re-placement actually moved) — `wait for clean` proper."""
+        await self.wait_active(timeout)
+
+        async def _wait():
+            while self.mon.osdmap.pg_temp:
+                await asyncio.sleep(0.02)
+        await asyncio.wait_for(_wait(), timeout)
+        await self.wait_active(timeout)
+
     async def wait_active(self, timeout: float = 10.0) -> None:
         """Wait until every live OSD's PGs are active and map epochs have
         converged (the `ceph health` wait-for-clean role)."""

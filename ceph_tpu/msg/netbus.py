@@ -1,0 +1,168 @@
+"""NetBus: the LocalBus contract over real TCP sockets, for daemons
+running as separate OS processes (the AsyncMessenger + entity-addressing
+role, src/msg/async/AsyncMessenger.h:74 + src/msg/Messenger.h).
+
+One NetBus per process. It owns ONE TcpMessenger (one listening socket);
+every entity the process hosts (``register``) is published in a shared
+file-based **address book** directory — one file per entity name holding
+``host port`` (the monmap/osdmap addrvec role: how peers find each
+other). Cross-process sends wrap the message in an MEnvelope carrying
+the entity-level src/dst and ride the messenger's CRC-framed (and, with
+``keys``, cephx-authenticated / AES-GCM secure) connections.
+
+Contract parity with LocalBus (msg/messenger.py):
+- ``register(name, dispatcher)`` / ``unregister(name)`` — entities come
+  and go at runtime; the public ``"mon"`` alias moves between paxos
+  leaders by exactly this mechanism, so book entries are written and
+  removed ownership-checked.
+- ``await send(src, dst, msg)`` — raises SendError when the destination
+  is not in the book or its process is unreachable (the caller-retry
+  stance: MonClient hunting and Objecter resend handle it).
+- ``entities`` — the local handler table (paxos' alias-ownership check
+  reads it).
+
+kill -9 of a process leaves its book entries behind; senders then get
+connection-refused -> SendError, indistinguishable from a LocalBus
+send to a dead entity — which is the behavior the cluster layer is
+built against.
+"""
+from __future__ import annotations
+
+import asyncio
+import os
+from typing import Awaitable, Callable
+
+from ..cluster.messages import MEnvelope
+from .messages import decode_message
+from .messenger import SendError, TcpMessenger
+
+Dispatcher = Callable[[str, object], Awaitable[None]]
+
+
+class NetBus:
+    def __init__(self, book_dir: str, keys=None, secure: bool = False,
+                 host: str = "127.0.0.1"):
+        self.book_dir = book_dir
+        os.makedirs(book_dir, exist_ok=True)
+        self.host = host
+        self.entities: dict[str, Dispatcher] = {}
+        #: LocalBus test-hook parity; process-level tests use signals
+        #: instead, so this only gates outgoing sends
+        self.blackholes: set[str] = set()
+        # one shared node identity: the cephx handshake authenticates
+        # the PROCESS link (entity-level identity rides the envelope);
+        # a fixed name lets every node share one keyring entry
+        self._node = "node"
+        self._tcp = TcpMessenger(self._node, self._dispatch, keys=keys,
+                                 secure=secure)
+        self._addr: tuple[str, int] | None = None
+        self._tasks: set[asyncio.Task] = set()
+        #: entity -> (host, port) resolution cache, invalidated on
+        #: send failure (peers re-listen on new ports after restart)
+        self._cache: dict[str, tuple[str, int]] = {}
+
+    # ----------------------------------------------------------- lifecycle
+
+    async def start(self) -> None:
+        if self._addr is None:
+            self._addr = await self._tcp.listen(self.host, 0)
+
+    async def close(self) -> None:
+        for name in list(self.entities):
+            self.unregister(name)
+        await self._tcp.close()
+        for t in list(self._tasks):
+            t.cancel()
+
+    # ----------------------------------------------------- entity registry
+
+    def _book_path(self, name: str) -> str:
+        # entity names are shell-safe ("osd.3", "client.0", "mon")
+        return os.path.join(self.book_dir, name)
+
+    def _publish(self, name: str) -> None:
+        assert self._addr is not None, "NetBus.start() first"
+        tmp = self._book_path(name) + f".tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            f.write(f"{self._addr[0]} {self._addr[1]}\n")
+        os.replace(tmp, self._book_path(name))  # atomic vs readers
+
+    def register(self, name: str, dispatcher: Dispatcher) -> None:
+        self.entities[name] = dispatcher
+        self._publish(name)
+
+    def unregister(self, name: str) -> None:
+        self.entities.pop(name, None)
+        self.blackholes.discard(name)
+        try:
+            # ownership check: another process (a new mon leader) may
+            # have re-published the name meanwhile — only remove OUR
+            # registration
+            with open(self._book_path(name)) as f:
+                host, port = f.read().split()
+            if (host, int(port)) == self._addr:
+                os.unlink(self._book_path(name))
+        except (OSError, ValueError):
+            pass
+
+    def _resolve(self, name: str) -> tuple[str, int]:
+        addr = self._cache.get(name)
+        if addr is not None:
+            return addr
+        try:
+            with open(self._book_path(name)) as f:
+                host, port = f.read().split()
+            addr = (host, int(port))
+        except (OSError, ValueError):
+            raise SendError(f"no such entity {name!r}") from None
+        self._cache[name] = addr
+        return addr
+
+    # ------------------------------------------------------------ transport
+
+    async def send(self, src: str, dst: str, msg) -> None:
+        if dst in self.blackholes or src in self.blackholes:
+            return
+        env = MEnvelope(src=src, dst=dst, mtype=msg.TYPE,
+                        payload=msg.encode())
+        local = self.entities.get(dst)
+        if local is not None:
+            # same-process delivery: scheduled, never inline (the
+            # LocalBus re-entrancy stance)
+            task = asyncio.get_running_loop().create_task(
+                local(src, decode_message(msg.TYPE, env.payload)))
+            self._tasks.add(task)
+            task.add_done_callback(self._tasks.discard)
+            return
+        addr = self._resolve(dst)
+        node = f"@{addr[0]}:{addr[1]}"
+        self._tcp.addrbook[node] = addr
+        try:
+            await self._tcp.send(node, env)
+        except SendError:
+            self._cache.pop(dst, None)  # stale book/port: re-resolve once
+            addr = self._resolve(dst)
+            node = f"@{addr[0]}:{addr[1]}"
+            self._tcp.addrbook[node] = addr
+            try:
+                await self._tcp.send(node, env)
+            except SendError:
+                self._cache.pop(dst, None)
+                raise
+
+    async def _dispatch(self, _node_src: str, env) -> None:
+        if not isinstance(env, MEnvelope):
+            return  # stray non-envelope frame: drop
+        handler = self.entities.get(env.dst)
+        if handler is None:
+            return  # entity moved/died after the sender resolved it
+        msg = decode_message(env.mtype, env.payload)
+        await handler(env.src, msg)
+
+    async def drain(self) -> None:
+        """Local-delivery drain (LocalBus parity; cross-process traffic
+        cannot be awaited from here)."""
+        while self._tasks:
+            await asyncio.gather(*list(self._tasks),
+                                 return_exceptions=True)
+            await asyncio.sleep(0)

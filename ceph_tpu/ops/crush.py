@@ -191,6 +191,37 @@ def crush_ln(u: jax.Array) -> jax.Array:
 # ------------------------------------------------------------------ straw2
 
 
+def _div_u48(n: jax.Array, w: jax.Array) -> jax.Array:
+    """Exact floor(n / w) for int64 n in [0, 2^48], w in [1, 2^32).
+
+    XLA lowers emulated-int64 `//` to bit-serial long division (~64
+    dependent steps/lane) — the round-3 straw2 ceiling. This replaces
+    it with three float32 reciprocal rounds plus exact int64 remainder
+    corrections (wraparound-safe: every q*w is congruent mod 2^64 to
+    the true product, and the true remainder fits):
+
+      round 1: q ~= n/w      quotient <= 2^48, fp32 rel err 2^-23
+               -> remainder |r| <~ 2^26
+      round 2: refine on r   -> |r| <~ 8*w
+      round 3: refine again  -> quotient off by at most ~1
+      two conditional +-1 steps land it exactly.
+
+    Bit-exactness is pinned by tests/test_crush_ops.py against the C++
+    host core across the full (n, w) corner lattice.
+    """
+    wf = w.astype(jnp.float32)
+    q = jnp.floor(n.astype(jnp.float32) / wf).astype(_I64)
+    r = n - q * w
+    q = q + jnp.trunc(r.astype(jnp.float32) / wf).astype(_I64)
+    r = n - q * w
+    q = q + jnp.trunc(r.astype(jnp.float32) / wf).astype(_I64)
+    r = n - q * w
+    q = q + (r >= w).astype(_I64) - (r < 0).astype(_I64)
+    r = n - q * w
+    q = q + (r >= w).astype(_I64) - (r < 0).astype(_I64)
+    return q
+
+
 @_x64
 def straw2_draw(
     x: jax.Array, item_id: jax.Array, r: jax.Array, weight: jax.Array
@@ -206,7 +237,7 @@ def straw2_draw(
     # trunc == -((2^48 - ln) // w) with nonneg floor division.
     neg = _I64(0x1000000000000) - ln
     w = weight.astype(_I64)
-    q = -(neg // jnp.maximum(w, _I64(1)))
+    q = -_div_u48(neg, jnp.maximum(w, _I64(1)))
     return jnp.where(w == 0, _I64(INT64_MIN), q)
 
 

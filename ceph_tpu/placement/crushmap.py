@@ -1,9 +1,10 @@
 """CRUSH map model + host rule engine (the oracle).
 
 A faithful Python port of the reference's C mapper semantics
-(src/crush/mapper.c): straw2 and uniform buckets, firstn and indep choose
-modes, chooseleaf recursion, reweight-based is_out rejection, and the
-jewel-era tunables. Used directly for small lookups (mon-side map
+(src/crush/mapper.c): all five bucket algorithms (straw2, uniform,
+list, tree, straw1), firstn and indep choose modes, chooseleaf
+recursion, reweight-based is_out rejection, and the jewel-era
+tunables. Used directly for small lookups (mon-side map
 operations, tests) and as the bit-exactness oracle for the vectorized
 device engine (placement/bulk.py).
 
@@ -11,8 +12,9 @@ Scalar GF-free integer primitives come from the C++ native core
 (ceph_tpu.native) — the same functions the device kernels are verified
 against.
 
-Unsupported legacy bucket algs (list, tree, straw1) raise; everything
-Ceph creates by default since jewel is straw2.
+All four legacy bucket algorithms (uniform, list, tree, straw1) are
+implemented alongside straw2 — pre-jewel maps decode and map
+bit-exactly; straw2 is what Ceph creates by default since jewel.
 """
 from __future__ import annotations
 

@@ -194,6 +194,9 @@ SCHEMA = Schema([
            desc="PGLog entries retained for delta recovery", min=1),
     Option("osd_subop_timeout", "secs", 3.0,
            desc="peer sub-op reply deadline", min=0.01),
+    Option("osd_max_backfills", "int", 2,
+           desc="concurrent recoveries/backfills per OSD, local and "
+                "remote slots alike (AsyncReserver role)", min=1),
     Option("osd_ec_batch_window", "secs", 0.0,
            desc="extra wait to accrete EC stripes into one device batch"),
     Option("store_kind", "str", "memstore",

@@ -279,3 +279,103 @@ def test_mark_out_replaces_member():
         await c.stop()
 
     run(t())
+
+
+def test_primary_crash_mid_fanout_survivors_converge():
+    """VERDICT r3 #6: kill the primary after SOME (not all) replicas
+    committed a rep-op. The unacked entry lives on one survivor only;
+    the new interval must converge both survivors to one authoritative
+    state, the client's resend must land exactly once, and a scrub must
+    come back clean — acks were never lied about."""
+    async def t():
+        c = await make_cluster(5)
+        await c.client.create_pool(
+            Pool(id=1, name="rep", size=3, pg_num=4, crush_rule=0)
+        )
+        await c.wait_active(20)
+        base = b"stable" * 500
+        await c.client.write_full(1, "torn", base)
+        pgid = c.mon.osdmap.object_to_pg(1, b"torn")
+        acting, primary = c.mon.osdmap.pg_to_up_acting_osds(pgid)
+        r1, r2 = [o for o in acting if o != primary]
+
+        # blackhole r2: the primary's fan-out commits on r1 only
+        c.bus.blackholes.add(f"osd.{r2}")
+        newdata = b"half-committed" * 400
+        wtask = asyncio.ensure_future(
+            c.client.write_full(1, "torn", newdata))
+        # let the rep-op land on r1 (but never on r2), then crash the
+        # primary before it can gather all-ack or answer the client
+        for _ in range(200):
+            await asyncio.sleep(0.005)
+            osd1 = c.osds[r1]
+            pgs = [pg for pg in osd1.pgs.values()
+                   if (pg.pgid[0], pg.pgid[1]) == pgid]
+            if pgs and any(e.oid == b"torn" and e.version[1] >= 2
+                           for e in pgs[0].log.entries):
+                break
+        await c.kill_osd(primary)
+        c.bus.blackholes.discard(f"osd.{r2}")
+        await c.wait_down(primary, 30)
+
+        # the client's pending write must complete via the new interval
+        await asyncio.wait_for(wtask, 60)
+        assert await c.client.read(1, "torn") == newdata
+
+        # survivors converged: same log head, same object bytes
+        await c.wait_active(40)
+        heads, versions = set(), set()
+        for o in (r1, r2):
+            for pg in c.osds[o].pgs.values():
+                if (pg.pgid[0], pg.pgid[1]) == pgid:
+                    heads.add(pg.log.head)
+                    versions.add(
+                        bytes(c.osds[o].store.read(pg.cid, b"torn")))
+        assert len(heads) == 1, f"divergent survivor logs: {heads}"
+        assert versions == {newdata}
+
+        # the revived old primary (which applied locally pre-crash)
+        # must also converge, not resurrect its unacked ordering
+        await c.revive_osd(primary)
+        await c.wait_active(40)
+        assert await c.client.read(1, "torn") == newdata
+        report = await c.scrub_pg(pgid)
+        assert report["inconsistent"] == [], report
+        await c.stop()
+
+    run(t())
+
+
+def test_primary_crash_no_replica_committed():
+    """Same crash, but NO replica saw the rep-op (both blackholed):
+    the entry exists only on the dead primary. The new interval serves
+    the PRIOR state until the client's resend re-applies the write."""
+    async def t():
+        c = await make_cluster(5)
+        await c.client.create_pool(
+            Pool(id=1, name="rep", size=3, pg_num=4, crush_rule=0)
+        )
+        await c.wait_active(20)
+        base = b"old-state" * 300
+        await c.client.write_full(1, "obj", base)
+        pgid = c.mon.osdmap.object_to_pg(1, b"obj")
+        acting, primary = c.mon.osdmap.pg_to_up_acting_osds(pgid)
+        replicas = [o for o in acting if o != primary]
+        for r in replicas:
+            c.bus.blackholes.add(f"osd.{r}")
+        newdata = b"never-acked" * 350
+        wtask = asyncio.ensure_future(
+            c.client.write_full(1, "obj", newdata))
+        await asyncio.sleep(0.05)  # primary applied locally, fanout dark
+        await c.kill_osd(primary)
+        for r in replicas:
+            c.bus.blackholes.discard(f"osd.{r}")
+        await c.wait_down(primary, 30)
+        await asyncio.wait_for(wtask, 60)  # resend lands on new primary
+        assert await c.client.read(1, "obj") == newdata
+        await c.wait_active(40)
+        report = await c.scrub_pg(pgid)
+        assert report["inconsistent"] == [], report
+        await c.stop()
+
+    run(t())

@@ -1,0 +1,142 @@
+"""Multi-process cluster tier: mon + OSDs as separate OS processes over
+real TCP sockets (the vstart.sh + qa/standalone role — VERDICT r3 #1).
+
+What this tier proves that the in-process tier cannot: the wire is real
+(kernel sockets, process isolation), kill -9 is a REAL crash (the
+process dies mid-whatever, no cooperative cleanup), and revival is a
+cold daemon start that must recover from its on-disk store.
+"""
+import asyncio
+import os
+import signal
+
+import pytest
+
+from ceph_tpu.cluster.procstart import ProcCluster
+from ceph_tpu.placement.osdmap import Pool
+
+
+def run(coro, timeout=240):
+    asyncio.run(asyncio.wait_for(coro, timeout))
+
+
+async def make(tmp, n_osds=3, n_mons=1, auth=False, secure=False):
+    c = ProcCluster(str(tmp), n_osds=n_osds, n_mons=n_mons,
+                    auth=auth, secure=secure)
+    await c.start()
+    await c.client.create_pool(
+        Pool(id=1, name="p", size=3, pg_num=8, crush_rule=0))
+    await c.wait_active(60)
+    return c
+
+
+def test_multiprocess_io_roundtrip(tmp_path):
+    """Write/read through real sockets: client process -> OSD
+    processes, replicated pool."""
+    async def t():
+        c = await make(tmp_path)
+        try:
+            payload = {f"obj{i}": os.urandom(2000 + 37 * i)
+                       for i in range(12)}
+            for name, data in payload.items():
+                await c.client.write_full(1, name, data)
+            for name, data in payload.items():
+                assert await c.client.read(1, name) == data
+            listed = await c.client.list_objects(1)
+            assert sorted(listed) == sorted(
+                n.encode() for n in payload)
+        finally:
+            await c.stop()
+
+    run(t())
+
+
+def test_multiprocess_kill9_and_revive(tmp_path):
+    """kill -9 an OSD *process*; the mon marks it down, IO keeps
+    working degraded; a cold restart mounts the same store and the
+    cluster heals with no lost data."""
+    async def t():
+        c = await make(tmp_path)
+        try:
+            data = {f"k{i}": os.urandom(4096) for i in range(10)}
+            for n, d in data.items():
+                await c.client.write_full(1, n, d)
+            c.kill_osd(1, signal.SIGKILL)
+            await c.wait_down(1, 40)
+            # degraded reads AND writes still serve
+            for n, d in data.items():
+                assert await c.client.read(1, n) == d
+            await c.client.write_full(1, "while-down", b"degraded")
+            await c.revive_osd(1)
+            await c.wait_up(1, 40)
+            await c.wait_active(90)
+            for n, d in data.items():
+                assert await c.client.read(1, n) == d
+            assert await c.client.read(1, "while-down") == b"degraded"
+        finally:
+            await c.stop()
+
+    run(t())
+
+
+def test_multiprocess_full_restart_durability(tmp_path):
+    """Stop EVERY process; restart the whole cluster from disk; the
+    pool and its objects survive (the durable-store + mon-store
+    cold-boot arc, end to end over processes)."""
+    async def t():
+        c = await make(tmp_path)
+        await c.client.write_full(1, "persist", b"x" * 10_000)
+        await c.stop()
+
+        c2 = ProcCluster(str(tmp_path), n_osds=3, n_mons=1)
+        await c2.start()
+        try:
+            await c2.wait_active(60)
+            assert await c2.client.read(1, "persist") == b"x" * 10_000
+            await c2.client.write_full(1, "again", b"second life")
+            assert await c2.client.read(1, "again") == b"second life"
+        finally:
+            await c2.stop()
+
+    run(t())
+
+
+def test_multiprocess_cephx_secure(tmp_path):
+    """The same tier with cephx auth + AES-GCM secure wire on."""
+    async def t():
+        c = await make(tmp_path, auth=True, secure=True)
+        try:
+            await c.client.write_full(1, "sec", b"over-encrypted-tcp")
+            assert await c.client.read(1, "sec") == b"over-encrypted-tcp"
+        finally:
+            await c.stop()
+
+    run(t())
+
+
+def test_multiprocess_ec_pool(tmp_path):
+    """EC k=2,m=1 pool across OSD processes: encode on the primary's
+    process, shard sub-writes over real sockets, degraded read after a
+    process kill."""
+    async def t():
+        c = ProcCluster(str(tmp_path), n_osds=4)
+        await c.start()
+        try:
+            await c.client.create_pool(Pool(
+                id=2, name="ec", size=3, min_size=2, pg_num=4,
+                crush_rule=1, type="erasure",
+                ec_profile={"plugin": "rs_tpu", "k": "2", "m": "1"}))
+            await c.wait_active(90)
+            blob = os.urandom(40_000)
+            await c.client.write_full(2, "ec-obj", blob)
+            assert await c.client.read(2, "ec-obj") == blob
+            # kill a shard holder; reconstruction serves the read
+            pgid = c.client.osdmap.object_to_pg(2, b"ec-obj")
+            acting, _ = c.client.osdmap.pg_to_up_acting_osds(pgid)
+            c.kill_osd(acting[1], signal.SIGKILL)
+            await c.wait_down(acting[1], 40)
+            assert await c.client.read(2, "ec-obj") == blob
+        finally:
+            await c.stop()
+
+    run(t())
