@@ -18,6 +18,7 @@ OK = 0
 ENOENT = -2
 EIO = -5
 EAGAIN = -11
+EEXIST = -17
 ESTALE = -116
 
 
@@ -74,8 +75,9 @@ class MPoolCreate(Message):
 @register_message
 class MPoolCreateReply(Message):
     TYPE = 17
-    FIELDS = (("pool_id", "i32"), ("epoch", "u32"), ("tid", "u64"))
-    DEFAULTS = {"tid": 0}
+    FIELDS = (("pool_id", "i32"), ("epoch", "u32"), ("tid", "u64"),
+              ("result", "i32"))
+    DEFAULTS = {"tid": 0, "result": 0}
 
 
 @register_message

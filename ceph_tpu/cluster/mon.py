@@ -207,13 +207,21 @@ class MonLite:
                  if p.name == pool.name
                  and (pool.id < 0 or p.id == pool.id)), None)
             if existing is not None:
-                # idempotent by (id, name): the client resends when a
-                # reply is lost to a mon failover (MonClient role)
+                # idempotent by (id, name) ONLY when the spec matches:
+                # acking a same-name create with a DIFFERENT spec would
+                # let the caller believe its size/profile was applied.
+                # pg_num is excluded — the autoscaler mutates it live,
+                # so a retried create must not fail against a split.
+                same = all(
+                    getattr(existing, f) == getattr(pool, f)
+                    for f in ("size", "min_size", "crush_rule", "type",
+                              "ec_profile"))
                 await self.bus.send(
                     self.name, src,
                     M.MPoolCreateReply(pool_id=existing.id,
                                        epoch=self.osdmap.epoch,
-                                       tid=msg.tid),
+                                       tid=msg.tid,
+                                       result=M.OK if same else M.EEXIST),
                 )
                 return
             if pool.id < 0:

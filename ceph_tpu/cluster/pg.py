@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import asyncio
 import time
+from collections import OrderedDict
 from typing import TYPE_CHECKING
 
 import numpy as np
@@ -361,6 +362,17 @@ class PG:
         self._notify_id = 0
         self.state = "peering"
         self.waiting: list[tuple[str, M.MOSDOp]] = []
+        #: write-op dedup (the reference's reqid reply cache on the PG
+        #: log, PGLog.cc / PrimaryLogPG::check_in_progress_op role): the
+        #: client tick-resends in-flight ops (a write into a half-dead
+        #: TCP connection is lost silently), so a duplicate (src, tid)
+        #: must NOT re-execute a non-idempotent verb (append, cls index
+        #: mutations) or reinstall stale content over a newer write —
+        #: completed writes answer from the cache, in-flight/parked ones
+        #: swallow the duplicate (the original execution will reply)
+        self._req_replies: "OrderedDict[tuple, M.MOSDOpReply]" = \
+            OrderedDict()
+        self._req_inflight: set[tuple] = set()
         self.lock = asyncio.Lock()
         self._peer_task: asyncio.Task | None = None
         #: pg_temp migration state (acting != up): objects whose full
@@ -510,6 +522,10 @@ class PG:
         """Lost primaryship: bounce queued clients so they re-target."""
         waiting, self.waiting = self.waiting, []
         for src, m in waiting:
+            # ESTALE is a bounce, not a completion: drop the in-flight
+            # marker so the client's retry (same tid) is accepted if
+            # this PG becomes primary again
+            self._req_inflight.discard((src, m.tid))
             self.osd.spawn(
                 self.osd.send(
                     src,
@@ -522,8 +538,10 @@ class PG:
 
     # ====================================================== client ops ==
 
-    async def do_op(self, src: str, m: M.MOSDOp) -> None:
+    async def do_op(self, src: str, m: M.MOSDOp,
+                    requeued: bool = False) -> None:
         if not self.is_primary():
+            self._req_inflight.discard((src, m.tid))
             await self.osd.send(
                 src,
                 M.MOSDOpReply(tid=m.tid, result=M.ESTALE, data=b"", size=0,
@@ -536,12 +554,27 @@ class PG:
             # split moved it to a child while the client targeted the
             # parent): bounce so the client re-hashes on a fresh map —
             # accepting it would strand the object in the wrong PG
+            self._req_inflight.discard((src, m.tid))
             await self.osd.send(
                 src,
                 M.MOSDOpReply(tid=m.tid, result=M.ESTALE, data=b"", size=0,
                               outs=[], epoch=self.osd.osdmap.epoch),
             )
             return
+        # -- write-op dedup (reqid reply-cache role). Reads are
+        # idempotent and skip it; `requeued` re-entries are the PG's own
+        # park-queue drain, not network duplicates.
+        is_write = any(o[0] in WRITE_OPS or o[0] == "call" for o in m.ops)
+        if is_write:
+            key = (src, m.tid)
+            cached = self._req_replies.get(key)
+            if cached is not None:
+                await self.osd.send(src, cached)
+                return
+            if not requeued:
+                if key in self._req_inflight:
+                    return  # duplicate of a parked/executing op
+                self._req_inflight.add(key)
         if self.state != "active":
             self.waiting.append((src, m))
             return
@@ -619,6 +652,15 @@ class PG:
                                   size=0, outs=[],
                                   epoch=self.osd.osdmap.epoch)
         perf.tinc("op_latency", time.perf_counter() - t0)
+        if write_class:
+            key = (src, m.tid)
+            self._req_inflight.discard(key)
+            if reply.result != M.EAGAIN:
+                # EAGAIN asks the client to retry the SAME tid — caching
+                # it would freeze the failure; cache only final results
+                self._req_replies[key] = reply
+                while len(self._req_replies) > 512:
+                    self._req_replies.popitem(last=False)
         await self.osd.send(src, reply)
 
     # ------------------------------------------------- op-vector engine
@@ -1065,9 +1107,12 @@ class PG:
         k, n = codec.k, codec.get_chunk_count()
         live = {s: o for o, s in self.live_members()}
         if len(live) < k:
-            raise RuntimeError(
-                f"pg {self.pgid}: {len(live)} < k={k} shards"
-            )
+            # degraded below k: the write CANNOT be made durable right
+            # now. A clean retryable error (not a raw exception) so the
+            # client refreshes its map and retries — the PG usually
+            # heals within a few epochs (min_size gate role)
+            raise OpError(M.EAGAIN,
+                          f"pg {self.pgid}: {len(live)} < k={k} shards")
 
         if st8.deleted and not st8.whiteout_delete:
             shard_txns = {}
@@ -1779,11 +1824,18 @@ class PG:
                 if missing is None:
                     await self._backfill_peer(o, s)
                 else:
+                    all_acked = True
                     for oid, e in missing.items():
                         if self._subop_misdirected(oid):
                             continue  # split stray: child PG owns it
                         try:
-                            await self._push_object(o, s, oid, e)
+                            if not await self._push_object(o, s, oid, e):
+                                # ack TIMEOUT: the peer may not hold the
+                                # content — converging its log head over
+                                # the gap would report it clean while
+                                # silently stale (round-4 advisor);
+                                # retry the whole round instead
+                                all_acked = False
                         except RuntimeError:
                             # unreconstructable (e.g. the log entry of
                             # a bounced degraded write that never
@@ -1794,12 +1846,15 @@ class PG:
                             osd.perf.inc("recovery_unfound")
                             osd.log_exc(
                                 f"pg {self.pgid} unfound {oid!r}")
-                # converge the peer's LOG POSITION unconditionally:
-                # when every push above was skipped (split strays,
-                # unfound debris), no message carried our last_update,
-                # and a peer left behind would fence every subsequent
-                # sub-write against the activation-seeded acked_head —
-                # a permanent livelock (round-4 EC-split finding)
+                    if not all_acked:
+                        return False
+                # converge the peer's LOG POSITION when every CONTENT
+                # push either landed or was legitimately skipped (split
+                # strays, unfound debris — no message carried our
+                # last_update, and a peer left behind would fence every
+                # subsequent sub-write against the activation-seeded
+                # acked_head, a permanent livelock; round-4 EC-split
+                # finding). Push timeouts return above and retry.
                 await self._push_log_head(o, s)
         finally:
             if held_local:
@@ -1823,7 +1878,7 @@ class PG:
         self.kick_migration()
         waiting, self.waiting = self.waiting, []
         for src, m in waiting:
-            osd.spawn(self.do_op(src, m))
+            osd.spawn(self.do_op(src, m, requeued=True))
         return True
 
     # ================================================ pg_temp migration ==

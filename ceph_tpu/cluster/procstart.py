@@ -30,7 +30,8 @@ _REPO_ROOT = os.path.dirname(os.path.dirname(
 class ProcCluster:
     def __init__(self, data_dir: str, n_osds: int = 3, n_mons: int = 1,
                  objectstore: str = "walstore", auth: bool = False,
-                 secure: bool = False, spawn_timeout: float = 30.0):
+                 secure: bool = False, spawn_timeout: float = 30.0,
+                 tpu_osd: int | None = None):
         self.data_dir = data_dir
         self.book = os.path.join(data_dir, "book")
         self.n_osds = n_osds
@@ -38,6 +39,10 @@ class ProcCluster:
         self.objectstore = objectstore
         self.secure = secure
         self.spawn_timeout = spawn_timeout
+        #: opt-in: this ONE OSD runs jax on the default platform (the
+        #: real chip when present) instead of pinned CPU — the only safe
+        #: way to put the tunnel chip in a process-tier data path
+        self.tpu_osd = tpu_osd
         os.makedirs(self.book, exist_ok=True)
         if auth or secure:
             entities = (["mon"]
@@ -66,9 +71,12 @@ class ProcCluster:
         env = dict(os.environ)
         env["PYTHONPATH"] = _REPO_ROOT + os.pathsep + env.get(
             "PYTHONPATH", "")
-        # daemons never need the real chip; CPU jax keeps spawns light
-        # and leaves the tunnel device to the test process
-        env.setdefault("JAX_PLATFORMS", "cpu")
+        # daemons default to pinned CPU jax (enforced INSIDE daemon.py
+        # via jax.config — the axon plugin ignores the JAX_PLATFORMS env
+        # var); at most the one opted-in OSD touches the real chip
+        platform = ("default"
+                    if role == "osd" and ident == self.tpu_osd
+                    else "cpu")
         args = [
             sys.executable, "-m", "ceph_tpu.cluster.daemon",
             "--role", role, "--id", str(ident),
@@ -76,6 +84,7 @@ class ProcCluster:
             "--n-osds", str(self.n_osds),
             "--n-mons", str(self.n_mons),
             "--objectstore", self.objectstore,
+            "--platform", platform,
         ]
         if self.secure:
             args.append("--secure")

@@ -94,16 +94,36 @@ class NetBus:
     def unregister(self, name: str) -> None:
         self.entities.pop(name, None)
         self.blackholes.discard(name)
+        # Ownership-checked removal must be ATOMIC across processes: a
+        # read-then-unlink lets a deposed mon leader read its own stale
+        # entry, lose the race to the new leader's republish, and unlink
+        # the NEW leader's entry. Claim the entry with an atomic rename
+        # first; only the process that won the rename inspects it, and a
+        # claim that turns out to be someone else's is restored verbatim
+        # (same name, same content — republishing is idempotent).
+        path = self._book_path(name)
+        claim = path + f".retire.{os.getpid()}"
         try:
-            # ownership check: another process (a new mon leader) may
-            # have re-published the name meanwhile — only remove OUR
-            # registration
-            with open(self._book_path(name)) as f:
+            os.rename(path, claim)
+        except OSError:
+            return  # already removed (or never published)
+        try:
+            with open(claim) as f:
                 host, port = f.read().split()
-            if (host, int(port)) == self._addr:
-                os.unlink(self._book_path(name))
+            ours = (host, int(port)) == self._addr
         except (OSError, ValueError):
-            pass
+            ours = False
+        if ours:
+            try:
+                os.unlink(claim)
+            except OSError:
+                pass
+        else:
+            # we yanked a newer owner's entry: put it back untouched
+            try:
+                os.replace(claim, path)
+            except OSError:
+                pass
 
     def _resolve(self, name: str) -> tuple[str, int]:
         addr = self._cache.get(name)

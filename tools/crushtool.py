@@ -15,8 +15,6 @@ import os
 import signal
 import sys
 
-# head-friendly: a closed stdout pipe is a normal way to consume a CLI
-signal.signal(signal.SIGPIPE, signal.SIG_DFL)
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
@@ -94,4 +92,9 @@ def main(argv=None) -> int:
 
 
 if __name__ == "__main__":
+    # head-friendly CLI: a closed stdout pipe is a normal exit. Set
+    # only when run as a program — at import time this would strip
+    # the hosting process (e.g. pytest) of CPython's SIGPIPE ignore
+    # and a later write to any dead socket would kill it (exit 141).
+    signal.signal(signal.SIGPIPE, signal.SIG_DFL)
     sys.exit(main())

@@ -19,7 +19,6 @@ import os
 import signal
 import sys
 
-signal.signal(signal.SIGPIPE, signal.SIG_DFL)
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 from ceph_tpu.utils.admin import admin_command  # noqa: E402
@@ -98,4 +97,9 @@ def main(argv=None) -> int:
 
 
 if __name__ == "__main__":
+    # head-friendly CLI: a closed stdout pipe is a normal exit. Set
+    # only when run as a program — at import time this would strip
+    # the hosting process (e.g. pytest) of CPython's SIGPIPE ignore
+    # and a later write to any dead socket would kill it (exit 141).
+    signal.signal(signal.SIGPIPE, signal.SIG_DFL)
     sys.exit(main())

@@ -29,7 +29,6 @@ import signal
 import sys
 import time
 
-signal.signal(signal.SIGPIPE, signal.SIG_DFL)
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 from ceph_tpu.cluster import TestCluster  # noqa: E402
@@ -397,4 +396,9 @@ def main(argv=None) -> int:
 
 
 if __name__ == "__main__":
+    # head-friendly CLI: a closed stdout pipe is a normal exit. Set
+    # only when run as a program — at import time this would strip
+    # the hosting process (e.g. pytest) of CPython's SIGPIPE ignore
+    # and a later write to any dead socket would kill it (exit 141).
+    signal.signal(signal.SIGPIPE, signal.SIG_DFL)
     sys.exit(main())
