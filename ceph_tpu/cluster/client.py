@@ -37,7 +37,13 @@ class RadosClient:
         self.name = name
         self.osdmap = None
         self.op_timeout = op_timeout
-        self._tid = 0
+        # tid doubles as the reqid the OSD's write dedup is keyed on
+        # (src, tid); the reference scopes reqids by an entity NONCE so
+        # a restarted client can never collide with its predecessor's
+        # cached replies — fold that nonce into the tid's high bits
+        import secrets
+
+        self._tid = secrets.randbits(31) << 32
         self._ops: dict[int, _InFlight] = {}
         self._map_waiters: list[asyncio.Future] = []
         self._snap_ops: dict[int, asyncio.Future] = {}

@@ -465,16 +465,23 @@ class MMonLease(Message):
 @register_message
 class MPaxosCollect(Message):
     TYPE = 74
-    # new leader recovering state (Paxos::collect role)
-    FIELDS = (("pn", "u64"), ("epoch", "u32"))
+    # new leader recovering state (Paxos::collect role); last_committed
+    # lets an AHEAD peon back-fill a revived-behind collector before it
+    # proposes anything (it would otherwise re-propose committed epochs)
+    FIELDS = (("pn", "u64"), ("epoch", "u32"), ("last_committed", "u32"))
+    DEFAULTS = {"last_committed": 0}
 
 
 @register_message
 class MPaxosLast(Message):
     TYPE = 75
+    # promised_pn tells a collector whose pn is below the peon's promise
+    # the floor it must exceed (Paxos OP_LAST pn-bump role) — without it
+    # a re-elected leader's begins are dropped silently forever
     FIELDS = (("pn", "u64"), ("rank", "u32"), ("last_committed", "u32"),
               ("uncommitted_pn", "u64"), ("uncommitted_ver", "u32"),
-              ("uncommitted_value", "bytes"))
+              ("uncommitted_value", "bytes"), ("promised_pn", "u64"))
+    DEFAULTS = {"promised_pn": 0}
 
 
 @register_message

@@ -237,26 +237,63 @@ class PaxosMon(MonLite):
             await asyncio.sleep(self.lease_interval)
 
     async def _leader_collect(self) -> None:
-        """Paxos::collect — recover uncommitted state from the quorum
-        and back-fill lagging peers."""
-        self.pn += self.n_mons  # fresh, globally unique pn
-        self._save_paxos()
-        self._collect_replies = {}
-        self._collect_fut = asyncio.get_running_loop().create_future()
-        for r in self.peers():
+        """Paxos::collect — recover uncommitted state from the quorum,
+        back-fill lagging peers, catch OURSELVES up from ahead peers,
+        and ratchet the proposal number above any promise out there."""
+        loop = asyncio.get_running_loop()
+        floor = 0
+        for _round in range(3):
+            # fresh, globally unique pn on this rank's residue class,
+            # strictly above any promise a peon reported (a re-elected
+            # leader whose pn trails a prior collector's would have its
+            # begins dropped silently — a permanent commit wedge)
+            base = 100 + self.rank
+            want = max(self.pn + self.n_mons, floor + 1)
+            steps = (want - base + self.n_mons - 1) // self.n_mons
+            self.pn = base + steps * self.n_mons
+            self._save_paxos()
+            self._collect_replies = {}
+            self._collect_fut = loop.create_future()
+            for r in self.peers():
+                try:
+                    await self.bus.send(
+                        self.name, f"mon.{r}",
+                        M.MPaxosCollect(
+                            pn=self.pn, epoch=self.election_epoch,
+                            last_committed=self.osdmap.epoch),
+                    )
+                except Exception:
+                    pass
             try:
-                await self.bus.send(
-                    self.name, f"mon.{r}",
-                    M.MPaxosCollect(pn=self.pn,
-                                    epoch=self.election_epoch),
-                )
-            except Exception:
+                await asyncio.wait_for(self._collect_fut,
+                                       self.accept_timeout)
+            except asyncio.TimeoutError:
                 pass
-        try:
-            await asyncio.wait_for(self._collect_fut,
-                                   self.accept_timeout)
-        except asyncio.TimeoutError:
-            pass
+            floor = max((rep.promised_pn
+                         for rep in self._collect_replies.values()),
+                        default=0)
+            if floor <= self.pn:
+                break
+        # a revived leader may be BEHIND the quorum it just won: the
+        # peons back-filled our gap with MPaxosCommit before their Last
+        # replies — wait (bounded) until those have applied, or our
+        # next commit would re-propose already-committed epochs and
+        # fork the map history
+        max_lc = max((rep.last_committed
+                      for rep in self._collect_replies.values()),
+                     default=0)
+        deadline = loop.time() + self.accept_timeout
+        while self.osdmap.epoch < max_lc and loop.time() < deadline:
+            await asyncio.sleep(0.02)
+        if self.osdmap.epoch < max_lc:
+            # STILL behind after the wait: proposing now would rebase
+            # onto a stale epoch and fork the committed history (peers
+            # drop the commit as "stale" while we apply it). Abdicate —
+            # the election loop re-runs, and the next collect round
+            # gets another back-fill attempt.
+            self._drop_alias()
+            self.leader = None
+            return
         best = self.uncommitted
         for rep in self._collect_replies.values():
             if rep.uncommitted_ver and (
@@ -412,6 +449,33 @@ class PaxosMon(MonLite):
             self._last_lease = time.monotonic()
 
     async def _handle_collect(self, src: str, msg: M.MPaxosCollect) -> None:
+        # a collector BEHIND our committed history must catch up before
+        # it proposes anything: back-fill it in order ahead of the Last
+        # reply (same ordered connection), so a revived leader rejoins
+        # at the quorum's epoch instead of forking numbering. A hole in
+        # our own history (we caught up via a full map once) falls back
+        # to shipping the full map — a partial back-fill would leave the
+        # collector gapped and stalled.
+        if msg.last_committed < self.osdmap.epoch:
+            span = range(msg.last_committed + 1, self.osdmap.epoch + 1)
+            if all(e in self.history for e in span):
+                for e in span:
+                    try:
+                        await self.bus.send(
+                            self.name, src,
+                            M.MPaxosCommit(version=e,
+                                           value=self.history[e]))
+                    except Exception:
+                        pass
+            else:
+                try:
+                    await self.bus.send(
+                        self.name, src,
+                        M.MOSDMapMsg(
+                            full=menc.encode_osdmap(self.osdmap),
+                            incrementals=[], epoch=self.osdmap.epoch))
+                except Exception:
+                    pass
         if msg.pn > self.promised_pn:
             self.promised_pn = msg.pn
             self._save_paxos()  # promises survive restarts too
@@ -425,6 +489,7 @@ class PaxosMon(MonLite):
                     uncommitted_pn=un[0] if un else 0,
                     uncommitted_ver=un[1] if un else 0,
                     uncommitted_value=un[2] if un else b"",
+                    promised_pn=self.promised_pn,
                 ),
             )
         except Exception:

@@ -540,8 +540,16 @@ class PG:
 
     async def do_op(self, src: str, m: M.MOSDOp,
                     requeued: bool = False) -> None:
+        # NOTE: the ESTALE bounces below drop the dedup marker ONLY for
+        # requeued originals (drained from `waiting`, marker set, not
+        # executing). A fresh op bounced here was never marked; a
+        # DUPLICATE must leave the original's marker alone (the
+        # original may be executing or parked — discarding would
+        # re-open the double-execute window). Parked originals are
+        # cleaned by _flush_waiting_stale, executing by _do_op_traced.
         if not self.is_primary():
-            self._req_inflight.discard((src, m.tid))
+            if requeued:
+                self._req_inflight.discard((src, m.tid))
             await self.osd.send(
                 src,
                 M.MOSDOpReply(tid=m.tid, result=M.ESTALE, data=b"", size=0,
@@ -554,7 +562,8 @@ class PG:
             # split moved it to a child while the client targeted the
             # parent): bounce so the client re-hashes on a fresh map —
             # accepting it would strand the object in the wrong PG
-            self._req_inflight.discard((src, m.tid))
+            if requeued:
+                self._req_inflight.discard((src, m.tid))
             await self.osd.send(
                 src,
                 M.MOSDOpReply(tid=m.tid, result=M.ESTALE, data=b"", size=0,
@@ -630,7 +639,7 @@ class PG:
                 async with self.lock:
                     outs, size = await self._execute_ops(
                         m.oid, m.ops, src=src, snapc=snapc,
-                        snapid=m.snapid)
+                        snapid=m.snapid, reqid=(src, m.tid))
             else:
                 outs, size = await self._execute_ops(
                     m.oid, m.ops, src=src, snapc=snapc, snapid=m.snapid)
@@ -667,6 +676,7 @@ class PG:
 
     async def _execute_ops(self, oid: bytes, ops, src: str = "",
                            snapc=(0, ()), snapid=sn.NOSNAP,
+                           reqid: tuple[str, int] = ("", 0),
                            ) -> tuple[list, int]:
         """Apply the op vector against a lazy working state of the
         object (do_osd_ops role): reads inside the vector see earlier
@@ -828,7 +838,8 @@ class PG:
             op_kind = (OP_DELETE
                        if st8.deleted and not st8.whiteout_delete
                        else OP_MODIFY)
-            entries.append(Entry(op_kind, oid, (epoch, seq), prior))
+            entries.append(Entry(op_kind, oid, (epoch, seq), prior,
+                                 reqid=reqid))
             if self.is_ec:
                 await self._write_ec_rmw(oid, st8, entries)
             else:
@@ -1874,6 +1885,23 @@ class PG:
         # peering just converged every member to our log: everything in
         # it counts as acked for the prefix fence
         self.acked_head = self.log.head
+        # rebuild the write-dedup reply cache from the log's reqids: a
+        # client whose reply was lost to the OLD primary's crash will
+        # tick-resend the same tid HERE, and re-executing it would
+        # double-apply (the reference rebuilds its reqid cache from
+        # pg_log_entry_t the same way). Only the newest 512 entries
+        # matter (cache cap), and a GENUINE cached reply — which may
+        # carry a cls call's payload the log cannot reconstruct — must
+        # never be overwritten by a fabricated bare-OK one.
+        for e in self.log.entries[-512:]:
+            if e.reqid[0]:
+                self._req_replies.setdefault(
+                    (e.reqid[0], e.reqid[1]),
+                    M.MOSDOpReply(tid=e.reqid[1], result=M.OK, data=b"",
+                                  size=0, outs=[(0, b"")],
+                                  epoch=osd.osdmap.epoch))
+        while len(self._req_replies) > 512:
+            self._req_replies.popitem(last=False)
         osd.kick_pg_snap_trim(self)  # new primary: catch up on removals
         self.kick_migration()
         waiting, self.waiting = self.waiting, []

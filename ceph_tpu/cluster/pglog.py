@@ -49,6 +49,12 @@ class Entry:
     oid: bytes
     version: tuple[int, int]
     prior_version: tuple[int, int] = ZERO
+    #: originating client request (entity name, tid) — rides the log so
+    #: write dedup survives a primary change: the new primary rebuilds
+    #: its reply cache from the log at activation (the reference keeps
+    #: osd_reqid_t in pg_log_entry_t for exactly this, PGLog.cc role).
+    #: ("", 0) for internal entries (clones, recovery markers).
+    reqid: tuple[str, int] = ("", 0)
 
     def encode(self) -> bytes:
         return b"".join(
@@ -59,6 +65,8 @@ class Entry:
                 denc.enc_u64(self.version[1]),
                 denc.enc_u32(self.prior_version[0]),
                 denc.enc_u64(self.prior_version[1]),
+                denc.enc_str(self.reqid[0]),
+                denc.enc_u64(self.reqid[1]),
             )
         )
 
@@ -70,7 +78,9 @@ class Entry:
         vs, off = denc.dec_u64(buf, off)
         pe, off = denc.dec_u32(buf, off)
         ps, off = denc.dec_u64(buf, off)
-        return cls(op, oid, (ve, vs), (pe, ps)), off
+        rname, off = denc.dec_str(buf, off)
+        rtid, off = denc.dec_u64(buf, off)
+        return cls(op, oid, (ve, vs), (pe, ps), (rname, rtid)), off
 
 
 @dataclass

@@ -77,6 +77,13 @@ class ProcCluster:
         platform = ("default"
                     if role == "osd" and ident == self.tpu_osd
                     else "cpu")
+        if platform == "default":
+            # the launcher itself may be CPU-pinned (pytest conftest
+            # sets JAX_PLATFORMS/XLA_FLAGS in os.environ); the chip
+            # opt-in must not inherit that pin or plugins that DO honor
+            # the env var silently land on CPU
+            env.pop("JAX_PLATFORMS", None)
+            env.pop("XLA_FLAGS", None)
         args = [
             sys.executable, "-m", "ceph_tpu.cluster.daemon",
             "--role", role, "--id", str(ident),
@@ -173,6 +180,34 @@ class ProcCluster:
         proc.send_signal(sig)
         proc.wait()
         self.procs[f"mon.{rank}"] = None
+
+    async def revive_mon(self, rank: int) -> None:
+        """Cold-restart a killed mon from its durable MonStore; it
+        rejoins the quorum and catches up via the collect round."""
+        self._spawn("mon", rank)
+        await self._wait_ready("mon", rank)
+
+    def leader_mon_rank(self) -> int:
+        """Which rank currently holds the public ``mon`` alias (the
+        paxos leader), resolved through the shared address book."""
+        def addr(name: str) -> tuple[str, int]:
+            with open(os.path.join(self.book, name)) as f:
+                host, port = f.read().split()
+            return host, int(port)
+
+        try:
+            alias = addr("mon")
+        except (OSError, ValueError):
+            # mid-election the alias is briefly unbound
+            raise RuntimeError("mon alias bound to no known rank") \
+                from None
+        for r in range(self.n_mons):
+            try:
+                if addr(f"mon.{r}") == alias:
+                    return r
+            except (OSError, ValueError):
+                continue
+        raise RuntimeError("mon alias bound to no known rank")
 
     # -------------------------------------------------------- wait helpers
 

@@ -119,9 +119,18 @@ class NetBus:
             except OSError:
                 pass
         else:
-            # we yanked a newer owner's entry: put it back untouched
+            # we yanked a newer owner's entry: put it back — via link,
+            # which creates ONLY if nobody republished during the claim
+            # window (os.replace would clobber an even-newer owner's
+            # fresh entry with the stale one we hold). A crash between
+            # rename and this restore loses the entry briefly; senders
+            # self-heal through the ranked mon.N hunt.
             try:
-                os.replace(claim, path)
+                os.link(claim, path)
+            except OSError:
+                pass  # FileExistsError: a fresh entry won; keep it
+            try:
+                os.unlink(claim)
             except OSError:
                 pass
 
