@@ -13,7 +13,7 @@ import asyncio
 from dataclasses import dataclass, field
 
 from ..placement import encoding as menc
-from ..placement.osdmap import Pool
+from ..placement.osdmap import PlacementMemo, Pool
 from ..utils import trace
 from . import messages as M
 
@@ -48,6 +48,7 @@ class RadosClient:
         self._map_waiters: list[asyncio.Future] = []
         self._snap_ops: dict[int, asyncio.Future] = {}
         self._watches: dict[tuple[bytes, int], object] = {}
+        self._placement = PlacementMemo()
         self._next_cookie = 0
         self._tracer = trace.get_tracer(name)
 
@@ -175,7 +176,7 @@ class RadosClient:
     # ------------------------------------------------------------- engine
 
     def _calc_target(self, pgid) -> int:
-        _up, primary = self.osdmap.pg_to_up_acting_osds(pgid)
+        _up, primary = self._placement.up_acting(self.osdmap, pgid)
         return primary
 
     async def _send_op(self, op: _InFlight) -> None:

@@ -13,6 +13,39 @@ from .snaps import NOSNAP
 PGID = "pair:i32:u32"
 EVERSION = "pair:u32:u64"
 
+
+def _enc_lazy_txn(v) -> bytes:
+    """Encode a store Transaction field that may still be the OBJECT:
+    in-process (LocalBus zero-copy) it is delivered as-is and never
+    encoded; only a wire messenger pays the marshalling cost here."""
+    from ..utils import denc
+
+    if not isinstance(v, (bytes, bytearray, memoryview)):
+        v = v.encode()
+    return denc.enc_bytes(bytes(v))
+
+
+def _enc_lazy_entries(v) -> bytes:
+    """Same stance for a log-entry list field."""
+    from ..utils import denc
+
+    if not isinstance(v, (bytes, bytearray, memoryview)):
+        v = denc.enc_list(v, lambda e: e.encode())
+    return denc.enc_bytes(bytes(v))
+
+
+def _dec_field_bytes(buf, off):
+    from ..utils import denc
+
+    return denc.dec_bytes(buf, off)
+
+
+#: field kinds for sub-op payloads: senders may pass the live object
+#: (Transaction / list[Entry]); wire encode marshals, local delivery
+#: ships the object. Receivers branch on type.
+LAZY_TXN = (_enc_lazy_txn, _dec_field_bytes)
+LAZY_ENTRIES = (_enc_lazy_entries, _dec_field_bytes)
+
 # op result codes (negated errno style, like the reference)
 OK = 0
 ENOENT = -2
@@ -275,8 +308,8 @@ class MOSDRepOp(Message):
     FIELDS = (
         ("tid", "u64"),
         ("pgid", PGID),
-        ("txn", "bytes"),  # encoded store Transaction
-        ("entry", "bytes"),  # encoded PGLog entry
+        ("txn", LAZY_TXN),  # store Transaction (object locally)
+        ("entry", LAZY_ENTRIES),  # PGLog entries (list locally)
         ("epoch", "u32"),
         # primary's log head BEFORE appending `entry`: the replica
         # refuses to append over a gap (prefix-log invariant — a
@@ -302,8 +335,8 @@ class MECSubWrite(Message):
         ("tid", "u64"),
         ("pgid", PGID),
         ("shard", "u32"),
-        ("txn", "bytes"),
-        ("entry", "bytes"),
+        ("txn", LAZY_TXN),
+        ("entry", LAZY_ENTRIES),
         ("epoch", "u32"),
         # RMW metadata (ECUtil hash_info role): per-cell CRC patches as
         # concat LE (u32 cell, u32 crc) pairs, the shard file's new cell

@@ -15,13 +15,16 @@ codec call (the reference's jerasure path) cannot.
 from __future__ import annotations
 
 import asyncio
+import os
 import sys
 import traceback
 
 import numpy as np
 
+from .. import native
 from ..ec import load_codec
 from ..placement import encoding as menc
+from ..placement.osdmap import PlacementMemo
 from ..store import transaction as tx_mod
 from ..store.memstore import MemStore
 from ..utils import config as cfg
@@ -44,7 +47,15 @@ def _op_bytes(msg) -> int:
 
 class ECBatcher:
     """Collects EC stripes for one reactor tick, encodes them as one
-    device batch per (codec profile, chunk words) bucket."""
+    batch per (codec profile, chunk words) bucket.
+
+    The batch runs on the engine the codec resolves to — the device
+    kernels, or the multithreaded C++ host core when the accelerator
+    link loses the measured-economics probe (ec/engine.py; the
+    reference's ISA-L-vs-jerasure runtime pick). Either way the encode
+    and its readback run in a worker thread, so the reactor keeps
+    serving ops while stripes are in flight — on a tunnel-attached chip
+    a blocking readback froze the whole OSD for ~0.5 s per batch."""
 
     def __init__(self, perf=None) -> None:
         self._pending: dict[tuple, list] = {}
@@ -57,56 +68,78 @@ class ECBatcher:
         The fixed stripe_unit layout (cluster/stripe.py) means every
         caller in the cluster shares one cell shape, so stripes from
         different objects/PGs submitted in the same reactor tick merge
-        into ONE device dispatch of ONE compiled kernel shape."""
-        from ..ops import rs
-
-        stripes = rs.pack_u32(np.ascontiguousarray(cells))  # (B, k, W/4)
+        into ONE dispatch of ONE compiled kernel shape."""
         key = (id(codec), cells.shape[-1])
         fut = asyncio.get_running_loop().create_future()
-        self._pending.setdefault(key, []).append((codec, stripes, fut))
+        self._pending.setdefault(key, []).append(
+            (codec, np.ascontiguousarray(cells), fut))
         if not self._flushing:
             self._flushing = True
             asyncio.get_running_loop().call_soon(self._flush)
-        parity_u32 = await fut
-        if parity_u32 is _FAILED:
+        parity = await fut
+        if parity is _FAILED:
             raise RuntimeError("batched encode failed")
-        return rs.unpack_u32(parity_u32)
+        return parity
 
     def _flush(self) -> None:
-        from ..ops import rs
-
         self._flushing = False
         pending, self._pending = self._pending, {}
+        loop = asyncio.get_running_loop()
         for (_cid, _su), items in pending.items():
-            codec = items[0][0]
-            batch = np.concatenate([stripes for _, stripes, _ in items])
-            # pad the batch axis to a power of two: jit specializes per
-            # shape, and on a tunnel-attached chip each fresh batch size
-            # costs a ~2 s compile — pow2 bucketing caps that at
-            # log2(max batch) compiles (zero stripes encode to zero
-            # parity and are sliced away below)
-            n = len(batch)
-            target = 1 << max(0, (n - 1)).bit_length()
-            if target != n:
-                pad = np.zeros((target - n,) + batch.shape[1:],
-                               dtype=batch.dtype)
-                batch = np.concatenate([batch, pad])
-            if self.perf is not None:
-                self.perf.inc("ec_batches")
-                self.perf.observe("ec_batch_stripes", n)
-            try:
-                parity = np.asarray(codec.encode_batch(batch))
-            except Exception:
-                for _, _, fut in items:
-                    if not fut.done():
-                        fut.set_result(_FAILED)
-                continue
-            row = 0
-            for _, stripes, fut in items:
-                b = len(stripes)
+            loop.create_task(self._encode_bucket(items))
+
+    async def _encode_bucket(self, items: list) -> None:
+        codec = items[0][0]
+        cells = (items[0][1] if len(items) == 1
+                 else np.concatenate([c for _, c, _ in items]))
+        if self.perf is not None:
+            self.perf.inc("ec_batches")
+            self.perf.observe("ec_batch_stripes", len(cells))
+        try:
+            parity = await asyncio.get_running_loop().run_in_executor(
+                None, self._encode_sync, codec, cells)
+        except Exception:
+            for _, _, fut in items:
                 if not fut.done():
-                    fut.set_result(parity[row : row + b])
-                row += b
+                    fut.set_result(_FAILED)
+            return
+        row = 0
+        for _, c, fut in items:
+            b = len(c)
+            if not fut.done():
+                fut.set_result(parity[row : row + b])
+            row += b
+
+    @staticmethod
+    def _encode_sync(codec, cells: np.ndarray) -> np.ndarray:
+        """(B, k, su) u8 -> (B, m, su) u8, on the resolved engine.
+        Runs in a worker thread: both the C++ core (ctypes releases the
+        GIL) and the jax transfer/readback overlap the reactor."""
+        engine = getattr(codec, "resolved_backend", lambda: "device")()
+        if engine == "host":
+            b, k, su = cells.shape
+            flat = np.ascontiguousarray(
+                cells.transpose(1, 0, 2)).reshape(k, b * su)
+            par = native.rs_encode(codec.matrix, flat,
+                                   threads=os.cpu_count() or 1)
+            return np.ascontiguousarray(
+                par.reshape(codec.m, b, su).transpose(1, 0, 2))
+        from ..ops import rs
+
+        batch = rs.pack_u32(cells)
+        # pad the batch axis to a power of two: jit specializes per
+        # shape, and on a tunnel-attached chip each fresh batch size
+        # costs a ~2 s compile — pow2 bucketing caps that at
+        # log2(max batch) compiles (zero stripes encode to zero
+        # parity and are sliced away below)
+        n = len(batch)
+        target = 1 << max(0, (n - 1)).bit_length()
+        if target != n:
+            pad = np.zeros((target - n,) + batch.shape[1:],
+                           dtype=batch.dtype)
+            batch = np.concatenate([batch, pad])
+        parity = np.asarray(codec.encode_batch(batch))
+        return rs.unpack_u32(parity[:n])
 
 
 class OSDLite:
@@ -156,6 +189,9 @@ class OSDLite:
             lambda _n, v: (self.local_reserver.set_max(v),
                            self.remote_reserver.set_max(v)))
         self.ec_batcher = ECBatcher(self.perf)
+        #: per-epoch placement memo (the daemon's map only moves
+        #: by epochs, so memoizing pg->up/acting is safe here)
+        self.placement = PlacementMemo()
         self.admin: AdminSocket | None = None
         # QoS between client / recovery / scrub traffic (mClock role)
         self.op_scheduler = MClockScheduler()
@@ -574,7 +610,7 @@ class OSDLite:
         if self.osdmap is None or pgid[0] not in self.osdmap.pools:
             return None
         pool = self.osdmap.pools[pgid[0]]
-        up, primary = self.osdmap.pg_to_up_acting_osds(pgid)
+        up, primary = self.placement.up_acting(self.osdmap, pgid)
         if primary != self.id or self.id not in up:
             return None
         shard = up.index(self.id) if pool.type == "erasure" else -1

@@ -26,6 +26,7 @@ pglog.py for the consequences for peering.
 from __future__ import annotations
 
 import asyncio
+import os as _os
 import time
 from collections import OrderedDict
 from typing import TYPE_CHECKING
@@ -422,7 +423,8 @@ class PG:
         """[(osd, shard)] of acting members per the CURRENT map, holes
         skipped. Computed from the osdmap (not the cached acting set) so
         the data path never acts on a stale membership snapshot."""
-        up, _ = self.osd.osdmap.pg_to_up_acting_osds(self.pgid)
+        up, _ = self.osd.placement.up_acting(self.osd.osdmap,
+                                             self.pgid)
         out = []
         for pos, o in enumerate(up):
             if o != NONE:
@@ -433,8 +435,8 @@ class PG:
         """[(osd, pos)] of UP members not in the acting set — the
         incoming members of a pg_temp-pinned migration (acting keeps
         serving while data flows to up; empty when acting == up)."""
-        up, _upp, acting, _ap = self.osd.osdmap.pg_to_up_acting_full(
-            self.pgid)
+        up, _upp, acting, _ap = self.osd.placement.full(
+            self.osd.osdmap, self.pgid)
         if up == acting:
             return []
         out = []
@@ -1052,15 +1054,16 @@ class PG:
         local.ops.extend(self._filter_remote_ops(mut))
         self._append_and_persist(entries, local)
         self.osd.store.queue_transaction(local)
-        enc_txn = mut.encode()
+        # live objects: LocalBus delivers by reference; wire
+        # messengers marshal via the LAZY_TXN/LAZY_ENTRIES codecs
 
         async def _ship(o: int):
             subtid = self.osd.new_subtid()
             fut = self.osd.expect_reply(subtid)
             await self.osd.send(
                 f"osd.{o}",
-                M.MOSDRepOp(tid=subtid, pgid=self.pgid, txn=enc_txn,
-                            entry=enc_entries(entries),
+                M.MOSDRepOp(tid=subtid, pgid=self.pgid, txn=mut,
+                            entry=entries,
                             epoch=self.osd.osdmap.epoch,
                             prev_head=self.acked_head,
                             trace=_trace_ctx()),
@@ -1213,13 +1216,28 @@ class PG:
             buf = ov.apply_range(start, end, old_parts.get(s, b""))
             arr = np.frombuffer(buf, dtype=np.uint8)
             cells[i].reshape(-1)[: arr.size] = arr
+        # Shard-major layout: one transpose copy gives every shard's
+        # cells as a CONTIGUOUS (T, su) block, so each write run below
+        # is one slice.tobytes() instead of a per-cell tobytes + join
+        # (the round-5 profile's dominant remaining memcpy), and the
+        # per-cell integrity CRCs batch into one multithreaded native
+        # call per side. A zero cell's CRC equals zero_cell_crc, so no
+        # special-casing.
         if tlist:
             parity = await osd.ec_batcher.encode_cells(codec, cells)
-            all_cells = np.concatenate([cells, parity], axis=1)
-        else:
-            all_cells = np.zeros((0, n, si.su), dtype=np.uint8)
-
-        zcrc = st.zero_cell_crc(si.su)
+            data_sh = np.ascontiguousarray(
+                cells.transpose(1, 0, 2))          # (k, T, su)
+            par_sh = np.ascontiguousarray(
+                parity.transpose(1, 0, 2))         # (m, T, su)
+            nthr = _os.cpu_count() or 1
+            crc_d = native.crc32c_batch(
+                data_sh.reshape(-1, si.su), threads=nthr
+            ).reshape(k, len(tlist))
+            crc_p = native.crc32c_batch(
+                par_sh.reshape(-1, si.su), threads=nthr
+            ).reshape(n - k, len(tlist))
+            nz_d = data_sh.any(axis=2)             # (k, T)
+            nz_p = par_sh.any(axis=2)              # (m, T)
         shard_txns: dict[int, tx.Transaction] = {}
         hpatches: dict[int, bytes] = {}
         for g in range(n):
@@ -1237,33 +1255,28 @@ class PG:
                 # are already consistent codewords)
                 t.truncate(cid, oid, new_nst * si.su)
             patch = np.zeros((len(tlist), 2), dtype="<u4")
-            w_start = None
-            w_cells: list[bytes] = []
+            if tlist:
+                rows = data_sh[g] if g < k else par_sh[g - k]
+                crc_g = crc_d[g] if g < k else crc_p[g - k]
+                nz_g = nz_d[g] if g < k else nz_p[g - k]
+            run_i = run_s = prev_s = -1
             for i, s in enumerate(tlist):
-                cell = all_cells[i, g]
-                if not cell.any():
-                    crc = zcrc
-                    # zero cell: covered by truncate zero-fill when the
-                    # file grew past it; otherwise must be written
-                    skip = s >= old_nst
-                else:
-                    crc = si.crc_of_cell(cell)
-                    skip = False
-                patch[i] = (s, crc)
-                if skip:
-                    if w_start is not None:
-                        t.write(cid, oid, w_start * si.su,
-                                b"".join(w_cells))
-                        w_start, w_cells = None, []
-                    continue
-                if w_start is None or s != w_start + len(w_cells):
-                    if w_start is not None:
-                        t.write(cid, oid, w_start * si.su,
-                                b"".join(w_cells))
-                    w_start, w_cells = s, []
-                w_cells.append(cell.tobytes())
-            if w_start is not None:
-                t.write(cid, oid, w_start * si.su, b"".join(w_cells))
+                # zero cell: covered by truncate zero-fill when the
+                # file grew past it; otherwise must be written
+                skip = (not nz_g[i]) and s >= old_nst
+                patch[i] = (s, crc_g[i])
+                if skip or (run_i >= 0 and s != prev_s + 1):
+                    if run_i >= 0:
+                        t.write(cid, oid, run_s * si.su,
+                                rows[run_i:i].tobytes())
+                        run_i = -1
+                if not skip:
+                    if run_i < 0:
+                        run_i, run_s = i, s
+                    prev_s = s
+            if run_i >= 0:
+                t.write(cid, oid, run_s * si.su,
+                        rows[run_i:len(tlist)].tobytes())
             for m_ in st8.xattr_muts:
                 if m_[0] == "set":
                     t.setattr(cid, oid, USER_ATTR + m_[1], m_[2])
@@ -1325,8 +1338,8 @@ class PG:
                 await osd.send(
                     f"osd.{target}",
                     M.MECSubWrite(tid=subtid, pgid=self.pgid, shard=pos,
-                                  txn=t.encode(),
-                                  entry=enc_entries(entries),
+                                  txn=t,
+                                  entry=entries,
                                   epoch=osd.osdmap.epoch, hpatch=hp,
                                   ncells=ncells, size=size,
                                   prev_head=self.acked_head,
@@ -1618,8 +1631,10 @@ class PG:
         return self.log.head < tuple(prev_head)
 
     async def handle_rep_op(self, src: str, m: M.MOSDRepOp) -> None:
-        t, _ = tx.Transaction.decode(m.txn)
-        entries = dec_entries(m.entry)
+        t = (m.txn if isinstance(m.txn, tx.Transaction)
+             else tx.Transaction.decode(m.txn)[0])
+        entries = (m.entry if isinstance(m.entry, list)
+                   else dec_entries(m.entry))
         if (self._subop_fenced(src, m.prev_head)
                 or self._subop_misdirected(entries[-1].oid)):
             await self.osd.send(
@@ -1646,8 +1661,10 @@ class PG:
         )
 
     async def handle_ec_write(self, src: str, m: M.MECSubWrite) -> None:
-        t, _ = tx.Transaction.decode(m.txn)
-        entries = dec_entries(m.entry)
+        t = (m.txn if isinstance(m.txn, tx.Transaction)
+             else tx.Transaction.decode(m.txn)[0])
+        entries = (m.entry if isinstance(m.entry, list)
+                   else dec_entries(m.entry))
         if (self._subop_fenced(src, m.prev_head)
                 or self._subop_misdirected(entries[-1].oid)):
             await self.osd.send(

@@ -101,9 +101,10 @@ class RSCodec(ErasureCode):
             raise ECError(f"only w=8 is supported, got w={w}")
         if self.k < 1 or self.m < 1 or self.k + self.m > 256:
             raise ECError(f"bad k={self.k} m={self.m} (k+m <= 256)")
-        self.backend = self.profile.get("backend", "device")
-        if self.backend not in ("device", "host"):
-            raise ECError(f"backend must be device|host, not {self.backend!r}")
+        self.backend = self.profile.get("backend", "auto")
+        if self.backend not in ("device", "host", "auto"):
+            raise ECError(
+                f"backend must be device|host|auto, not {self.backend!r}")
         self.per_chunk_alignment = self.to_bool(
             "jerasure-per-chunk-alignment", False
         )
@@ -149,6 +150,16 @@ class RSCodec(ErasureCode):
         return out
 
     # --------------------------------------------------- batched (device)
+
+    def resolved_backend(self) -> str:
+        """The engine batched data-path encodes actually run on:
+        "device"/"host" as configured, or the measured-economics probe
+        for "auto" (ec/engine.py — link bandwidth decides)."""
+        if self.backend == "auto":
+            from . import engine
+
+            return engine.data_path_engine()
+        return self.backend
 
     def encode_batch(self, data):
         """(B, k, W) uint32 -> (B, m, W) uint32 parity, one dispatch."""

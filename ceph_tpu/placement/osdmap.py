@@ -404,6 +404,43 @@ class OSDMap:
         self.epoch = inc.epoch
 
 
+class PlacementMemo:
+    """Per-epoch memo of pg_to_up_acting lookups, owned by daemons and
+    clients whose map ONLY changes through epochs (every change arrives
+    as an incremental or a newer full map). The data path asks for the
+    same pgid's mapping on every op; within an epoch CRUSH is a pure
+    function of the map, so recomputing it per op was ~20% of the
+    round-5 write-path profile. NOT safe for the mon or tools, which
+    edit map objects in place without bumping the epoch (the balancer's
+    what-if probes, test fixtures) — they must keep calling the map
+    directly."""
+
+    def __init__(self) -> None:
+        self._map: OSDMap | None = None
+        self._epoch = -1
+        self._memo: dict[tuple[int, int], tuple] = {}
+
+    def full(self, osdmap: "OSDMap", pgid: tuple[int, int]
+             ) -> tuple[list[int], int, list[int], int]:
+        if self._map is not osdmap or osdmap.epoch != self._epoch:
+            self._map = osdmap
+            self._epoch = osdmap.epoch
+            self._memo.clear()
+        hit = self._memo.get(pgid)
+        if hit is None:
+            up, upp, acting, ap = osdmap.pg_to_up_acting_full(pgid)
+            self._memo[pgid] = (tuple(up), upp, tuple(acting), ap)
+            return up, upp, acting, ap
+        up_t, upp, act_t, ap = hit
+        # fresh lists per call: callers mutate the vectors they get
+        return list(up_t), upp, list(act_t), ap
+
+    def up_acting(self, osdmap: "OSDMap", pgid: tuple[int, int]
+                  ) -> tuple[list[int], int]:
+        _up, _upp, acting, ap = self.full(osdmap, pgid)
+        return acting, ap
+
+
 @dataclass
 class Incremental:
     """Delta between epochs (OSDMap::Incremental, applied in order)."""

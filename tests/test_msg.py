@@ -142,3 +142,40 @@ def test_incremental_encoding_roundtrip():
                       new_pg_upmap_primaries={(1, 4): 2, (1, 5): None})
     inc2, _ = menc.decode_incremental(menc.encode_incremental(inc))
     assert inc2 == inc
+
+
+def test_lazy_subop_fields_wire_roundtrip():
+    """MECSubWrite/MOSDRepOp accept LIVE Transaction/entry-list objects
+    (LocalBus ships them by reference); the WIRE encode must marshal
+    them identically to pre-encoded bytes, or the process tier would
+    corrupt shard sub-ops (round-5 zero-copy change)."""
+    from ceph_tpu.cluster import messages as M
+    from ceph_tpu.cluster.pglog import Entry
+    from ceph_tpu.cluster.pg import enc_entries
+    from ceph_tpu.store import transaction as tx
+
+    t = tx.Transaction()
+    t.touch("1.0s0", b"obj")
+    t.write("1.0s0", b"obj", 0, b"payload-bytes" * 100)
+    t.setattr("1.0s0", b"obj", "k", b"v")
+    entries = [Entry("modify", b"obj", (3, 7), (3, 6),
+                     reqid=("client.0", 42))]
+
+    live = M.MECSubWrite(tid=1, pgid=(1, 0), shard=0, txn=t,
+                         entry=entries, epoch=3, hpatch=b"hp",
+                         ncells=1, size=1300, prev_head=(3, 6))
+    pre = M.MECSubWrite(tid=1, pgid=(1, 0), shard=0, txn=t.encode(),
+                        entry=enc_entries(entries), epoch=3,
+                        hpatch=b"hp", ncells=1, size=1300,
+                        prev_head=(3, 6))
+    assert live.encode() == pre.encode()
+    dec = M.MECSubWrite.decode(live.encode())
+    t2, _ = tx.Transaction.decode(dec.txn)
+    assert [op.code for op in t2.ops] == [op.code for op in t.ops]
+
+    live_r = M.MOSDRepOp(tid=2, pgid=(1, 1), txn=t, entry=entries,
+                         epoch=3, prev_head=(3, 6))
+    pre_r = M.MOSDRepOp(tid=2, pgid=(1, 1), txn=t.encode(),
+                        entry=enc_entries(entries), epoch=3,
+                        prev_head=(3, 6))
+    assert live_r.encode() == pre_r.encode()
