@@ -18,6 +18,15 @@ from ..utils import trace
 from . import messages as M
 
 
+class RadosError(IOError):
+    """Op-vector failure with its errno-style code attached (librados
+    negative-errno contract); str() keeps the legacy message shape."""
+
+    def __init__(self, code: int, what: str = ""):
+        super().__init__(what or f"op vector failed: {code}")
+        self.code = code
+
+
 @dataclass
 class _InFlight:
     msg: M.MOSDOp
@@ -92,7 +101,8 @@ class RadosClient:
             fut = self._snap_ops.get(msg.tid)
             if fut is not None and not fut.done():
                 fut.set_result(msg)
-        elif isinstance(msg, (M.MPoolSnapReply, M.MPoolSetReply)):
+        elif isinstance(msg, (M.MPoolSnapReply, M.MPoolSetReply,
+                              M.MBlocklistReply)):
             fut = self._snap_ops.get(msg.tid)
             if fut is not None and not fut.done():
                 fut.set_result(msg)
@@ -277,7 +287,13 @@ class RadosClient:
         if reply.result != M.OK:
             if reply.result == M.ENOENT:
                 raise KeyError(name)
-            raise IOError(f"op vector failed: {reply.result}")
+            if reply.result == M.EBLOCKLISTED:
+                # this client entity is fenced (its exclusive lock was
+                # stolen after it went unresponsive): fail everything
+                # loudly, never retry (librados EBLOCKLISTED contract)
+                raise ConnectionAbortedError(
+                    f"client {self.name} is blocklisted")
+            raise RadosError(reply.result)
         return reply
 
     async def operate(self, pool_id: int, name,
@@ -410,6 +426,18 @@ class RadosClient:
         """Mark a snap removed; OSDs trim clone data for it on the next
         map epoch (librados selfmanaged_snap_remove role)."""
         await self._pool_snap_op(pool_id, "remove", snapid)
+
+    async def blocklist_add(self, entity: str) -> None:
+        """Fence a client entity cluster-wide (`ceph osd blocklist add`
+        role); waits for the committed epoch so the fence is live."""
+        await self._mon_pool_op(
+            lambda tid: M.MBlocklist(entity=entity, op="add", tid=tid),
+            f"blocklist add {entity}")
+
+    async def blocklist_rm(self, entity: str) -> None:
+        await self._mon_pool_op(
+            lambda tid: M.MBlocklist(entity=entity, op="rm", tid=tid),
+            f"blocklist rm {entity}")
 
     async def set_pool_param(self, pool_id: int, key: str,
                              value: int) -> None:

@@ -52,6 +52,7 @@ ENOENT = -2
 EIO = -5
 EAGAIN = -11
 EEXIST = -17
+EBLOCKLISTED = -108  # ESHUTDOWN, the reference's blocklist errno
 ESTALE = -116
 
 
@@ -147,6 +148,23 @@ class MPoolSetReply(Message):
     TYPE = 80
     FIELDS = (("pool_id", "i32"), ("result", "i32"), ("epoch", "u32"),
               ("tid", "u64"))
+    DEFAULTS = {"tid": 0}
+
+
+@register_message
+class MBlocklist(Message):
+    TYPE = 86
+    # fence (op="add") / unfence (op="rm") a client entity (the
+    # `ceph osd blocklist` role): OSDs reject a fenced entity's ops on
+    # the committed epoch, making exclusive-lock steals safe
+    FIELDS = (("entity", "str"), ("op", "str"), ("tid", "u64"))
+    DEFAULTS = {"op": "add", "tid": 0}
+
+
+@register_message
+class MBlocklistReply(Message):
+    TYPE = 87
+    FIELDS = (("result", "i32"), ("epoch", "u32"), ("tid", "u64"))
     DEFAULTS = {"tid": 0}
 
 

@@ -162,6 +162,8 @@ class MonLite:
             await self._handle_pool_set(src, msg)
         elif isinstance(msg, M.MPGTempClear):
             await self._handle_pg_temp_clear(msg)
+        elif isinstance(msg, M.MBlocklist):
+            await self._handle_blocklist(src, msg)
         elif isinstance(msg, M.MConfigSet):
             await self._handle_config_set(msg)
         elif isinstance(msg, M.MUpmapItems):
@@ -366,6 +368,29 @@ class MonLite:
                     self.osdmap.pools[msg.pool_id] = saved
             await self.commit(inc)
         await reply(M.OK)
+
+    async def _handle_blocklist(self, src: str, msg: M.MBlocklist) -> None:
+        """Fence/unfence a client entity via a committed map epoch (the
+        OSDMonitor `osd blocklist` role): after the epoch propagates,
+        every OSD rejects the entity's ops with EBLOCKLISTED."""
+        already = msg.entity in self.osdmap.blocklist
+        if (msg.op == "add") == already:
+            # idempotent: already in the requested state
+            await self.bus.send(
+                self.name, src,
+                M.MBlocklistReply(result=M.OK, epoch=self.osdmap.epoch,
+                                  tid=msg.tid))
+            return
+        inc = self._new_inc()
+        if msg.op == "add":
+            inc.new_blocklist.append(msg.entity)
+        else:
+            inc.new_unblocklist.append(msg.entity)
+        await self.commit(inc)
+        await self.bus.send(
+            self.name, src,
+            M.MBlocklistReply(result=M.OK, epoch=self.osdmap.epoch,
+                              tid=msg.tid))
 
     async def _handle_pg_temp_clear(self, msg: M.MPGTempClear) -> None:
         """Primary reports migration done: drop the pg_temp pin so the

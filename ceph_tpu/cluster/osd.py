@@ -577,6 +577,18 @@ class OSDLite:
         if tracked is not None:
             tracked.mark("dequeued")
         try:
+            if (self.osdmap is not None
+                    and src in self.osdmap.blocklist):
+                # fenced entity (OSDMap::is_blocklisted role): its ops
+                # must never land — this is the guarantee that makes an
+                # exclusive-lock steal from a dead client safe
+                await self.send(
+                    src,
+                    M.MOSDOpReply(tid=msg.tid, result=M.EBLOCKLISTED,
+                                  data=b"", size=0, outs=[],
+                                  epoch=self.epoch),
+                )
+                return
             pg = self._pg_for_primary(msg.pgid)
             if pg is None:
                 if tracked is not None:

@@ -12,7 +12,7 @@ from ..utils import denc
 from . import crushmap as cm
 from .osdmap import Incremental, OSDMap, OSDState, Pool
 
-_V = 2  # v2: choose_args + device classes
+_V = 3  # v3: +osdmap blocklist
 
 
 # ----------------------------------------------------------------- crush
@@ -222,6 +222,7 @@ def encode_osdmap(m: OSDMap) -> bytes:
     out.append(
         denc.enc_map(m.primary_affinity, denc.enc_u32, denc.enc_u32)
     )
+    out.append(denc.enc_list(sorted(m.blocklist), denc.enc_str))
     return b"".join(out)
 
 
@@ -269,6 +270,8 @@ def decode_osdmap(buf: bytes, off: int = 0) -> tuple[OSDMap, int]:
     m.primary_affinity, off = denc.dec_map(
         buf, off, denc.dec_u32, denc.dec_u32
     )
+    bl, off = denc.dec_list(buf, off, denc.dec_str)
+    m.blocklist = set(bl)
     return m, off
 
 
@@ -307,6 +310,8 @@ def encode_incremental(inc: Incremental) -> bytes:
             denc.enc_map(inc.new_primary_temp, enc_pg, denc.enc_i32),
             denc.enc_map(inc.new_primary_affinity, denc.enc_u32,
                          denc.enc_u32),
+            denc.enc_list(inc.new_blocklist, denc.enc_str),
+            denc.enc_list(inc.new_unblocklist, denc.enc_str),
         )
     )
 
@@ -341,6 +346,8 @@ def decode_incremental(buf: bytes, off: int = 0) -> tuple[Incremental, int]:
     )
     ptemp, off = denc.dec_map(buf, off, dec_pg, denc.dec_i32)
     paff, off = denc.dec_map(buf, off, denc.dec_u32, denc.dec_u32)
+    bl, off = denc.dec_list(buf, off, denc.dec_str)
+    unbl, off = denc.dec_list(buf, off, denc.dec_str)
     return (
         Incremental(
             epoch=epoch, up=up, down=down, weights=weights, new_pools=pools,
@@ -350,6 +357,7 @@ def decode_incremental(buf: bytes, off: int = 0) -> tuple[Incremental, int]:
             },
             new_pg_temp=pg_temp, new_primary_temp=ptemp,
             new_primary_affinity=paff,
+            new_blocklist=bl, new_unblocklist=unbl,
         ),
         off,
     )

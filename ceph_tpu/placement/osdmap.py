@@ -151,6 +151,12 @@ class OSDMap:
         self.primary_temp: dict[tuple[int, int], int] = {}
         #: per-osd 16.16 primary affinity (0x10000 = default)
         self.primary_affinity: dict[int, int] = {}
+        #: fenced client entities (the reference's osd blocklist,
+        #: OSDMap::is_blocklisted role): OSDs reject their ops, which
+        #: is what makes breaking a dead client's exclusive lock SAFE —
+        #: the stale holder's in-flight writes can never land after the
+        #: steal
+        self.blocklist: set[str] = set()
         self._out_weights_cache: np.ndarray | None = None
 
     # ------------------------------------------------------------- state
@@ -400,6 +406,8 @@ class OSDMap:
                 self.primary_affinity.pop(osd, None)
             else:
                 self.primary_affinity[osd] = aff
+        self.blocklist.update(inc.new_blocklist)
+        self.blocklist.difference_update(inc.new_unblocklist)
         self._out_weights_cache = None
         self.epoch = inc.epoch
 
@@ -467,3 +475,6 @@ class Incremental:
         default_factory=dict
     )
     new_primary_affinity: dict[int, int] = field(default_factory=dict)
+    # fenced / unfenced client entity names (osd blocklist role)
+    new_blocklist: list[str] = field(default_factory=list)
+    new_unblocklist: list[str] = field(default_factory=list)

@@ -1,5 +1,20 @@
 """RBD-lite: block images on RADOS (the src/librbd role).
 
+Exclusive lock (src/librbd/ExclusiveLock.h:20 + exclusive_lock/ state
+machines): a writable image handle arbitrates ownership through the cls
+``lock`` class on the header object. Acquisition is lazy (first write),
+release is cooperative (the holder watches its header and releases when
+another handle notifies ``request_lock``), and an UNRESPONSIVE holder is
+stolen from: break_lock + an osdmap blocklist entry fence the old
+holder so its in-flight writes can never land (the reference's
+blocklist-on-steal arc).
+
+Object map (src/librbd/ObjectMap.h): a per-image bitmap of which data
+objects exist, maintained under the exclusive lock in the
+``rbd_object_map.<name>`` object. remove/flatten/rollback consult it
+instead of stat-ing every object (fast-diff role).
+
+
 An image is a FileLayout-striped set of data objects
 (``rbd_data.<name>.<objectno:016x>``, default 4 MiB object size /
 stripe_count 1 — the rbd default layout) plus a header object
@@ -21,6 +36,7 @@ Covered surface (librbd/Operations.cc + io/ dispatch roles):
 from __future__ import annotations
 
 import asyncio
+import secrets
 
 from ..osdc.striper import FileLayout, StripedReadResult, file_to_extents
 from ..utils import denc
@@ -39,6 +55,33 @@ ATTR_LAYOUT = "rbd.layout"
 ATTR_SNAPS = "rbd.snaps"  # list of (name, RADOS selfmanaged snap id)
 ATTR_SNAPSEQ = "rbd.snapseq"  # image SnapContext seq (monotone)
 ATTR_PARENT = "rbd.parent"  # "name@snap" of the clone source
+
+LOCK_NAME = "rbd_lock"  # the cls lock name (librbd RBD_LOCK_NAME)
+NOTIFY_REQUEST_LOCK = b"request_lock"
+ATTR_OMAP_BITS = "rbd.objectmap"  # 1 byte/object: 1 = exists
+
+
+class LockBusy(Exception):
+    """The exclusive lock is held by a live peer (EBUSY surface)."""
+
+
+class _LockGuard:
+    """Pins an Image's exclusive lock for the span of one mutating op:
+    release_lock (cooperative or explicit) drains guards before the
+    lock moves, so a peer can never observe a half-applied op."""
+
+    def __init__(self, img: "Image"):
+        self._img = img
+
+    async def __aenter__(self):
+        self._img._lock_users += 1
+        return self
+
+    async def __aexit__(self, *_exc):
+        self._img._lock_users -= 1
+        if self._img._lock_users == 0:
+            self._img._idle_ev.set()
+        return False
 
 
 def _enc_snaps(pairs: list[tuple[str, int]]) -> bytes:
@@ -65,6 +108,14 @@ def _header(name: str) -> str:
 
 def _data_fmt(name: str) -> str:
     return f"rbd_data.{name}." + "{objectno:016x}"
+
+
+def _omap_oid(name: str) -> str:
+    return f"rbd_object_map.{name}"
+
+
+def _enc_lock_input(*fields: str) -> bytes:
+    return b"".join(denc.enc_str(f) for f in fields)
 
 
 class RBD:
@@ -109,7 +160,13 @@ class RBD:
         img = await self.open(name)
         if img.snaps:
             raise RuntimeError(f"image {name} has snapshots")
+        await img.acquire_lock()  # loads/rebuilds the object map
         await img._remove_objects()
+        await img.release_lock()
+        try:
+            await self.client.delete(self.pool_id, _omap_oid(name))
+        except KeyError:
+            pass
         await self.client.delete(self.pool_id, _header(name))
 
     async def clone(self, parent: str, snap: str, child: str) -> None:
@@ -141,7 +198,7 @@ class Image:
     """One open image (librbd::Image role)."""
 
     def __init__(self, client, pool_id: int, name: str,
-                 snap: str | None = None):
+                 snap: str | None = None, exclusive: bool = True):
         self.client = client
         self.pool_id = pool_id
         self.name = name
@@ -153,6 +210,267 @@ class Image:
         self.snap_seq = 0
         self.parent: tuple[str, str] | None = None
         self._parent_snapid: int | None = None
+        #: exclusive-lock state (ExclusiveLock.h:20 role). The owner is
+        #: the CLIENT entity (what the blocklist fences); the cookie
+        #: distinguishes handles of one client.
+        self.exclusive = exclusive
+        self.lock_owned = False
+        self._lock_cookie = secrets.token_hex(8)
+        self._watch_cookie: int | None = None
+        self._releasing = False
+        #: object-map state bytes (valid only while lock_owned);
+        #: 0 = absent, 1 = exists, 2 = pending (see the object-map
+        #: section's invariants)
+        self._omap: bytearray | None = None
+        self._omap_dirty = False
+        #: in-flight guarded ops: release_lock drains these before the
+        #: lock changes hands (exclusivity across whole ops)
+        self._lock_users = 0
+        self._idle_ev = asyncio.Event()
+
+    # ----------------------------------------------------- exclusive lock
+
+    async def acquire_lock(self, timeout: float = 5.0,
+                           steal_dead: bool = True) -> None:
+        """Take the exclusive lock (lazily called by the write path).
+
+        Cooperative transition: on EBUSY, notify the header — a LIVE
+        holder releases when its in-flight IO drains and we retry. The
+        steal deadline applies PER HOLDER (it resets whenever the
+        observed holder changes): only an owner that sat unresponsive
+        through the whole window is broken + BLOCKLISTED (the
+        reference's acquire->request->break->blocklist arc); a fenced
+        holder's late writes bounce EBLOCKLISTED at every OSD."""
+        from ..cluster.client import RadosError
+
+        if self.lock_owned or self.snap is not None:
+            return
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + timeout
+        last_holder: tuple[str, str] | None = None
+        while True:
+            try:
+                await self.client.execute(
+                    self.pool_id, _header(self.name), "lock", "lock",
+                    _enc_lock_input(LOCK_NAME, "exclusive",
+                                    self.client.name, self._lock_cookie))
+                break
+            except RadosError as e:
+                if e.code != -16:  # not EBUSY
+                    raise
+            holder = await self._lock_holder()
+            if holder is None:
+                continue  # released between attempts
+            if holder != last_holder:
+                # a DIFFERENT owner took it (e.g. another waiter won a
+                # steal): it deserves its own full cooperative window —
+                # stealing from a live, freshly-acquired holder would
+                # blocklist a healthy client
+                last_holder = holder
+                deadline = loop.time() + timeout
+            # cooperative: ask the holder to let go
+            try:
+                await self.client.notify(
+                    self.pool_id, _header(self.name), NOTIFY_REQUEST_LOCK)
+            except Exception:
+                pass
+            await asyncio.sleep(0.05)
+            if loop.time() > deadline:
+                if not steal_dead:
+                    raise LockBusy(f"{self.name}: lock held by "
+                                   f"{holder[0]}/{holder[1]}")
+                await self._steal_lock(holder)
+        self.lock_owned = True
+        await self._load_object_map()
+        if self._watch_cookie is None:
+            self._watch_cookie = await self.client.watch(
+                self.pool_id, _header(self.name), self._header_notify)
+
+    async def _steal_lock(self, holder: tuple[str, str]) -> None:
+        """Fence-then-break (ExclusiveLock break_lock + blocklist):
+        the ORDER matters — blocklist first, so the dead holder's
+        in-flight writes can no longer land when the lock changes
+        hands."""
+        from ..cluster.client import RadosError
+
+        owner, _cookie = holder
+        if owner != self.client.name:
+            await self.client.blocklist_add(owner)
+        try:
+            await self.client.execute(
+                self.pool_id, _header(self.name), "lock", "break_lock",
+                _enc_lock_input(LOCK_NAME, owner))
+        except KeyError:
+            pass  # ENOENT: released while we were fencing
+        except RadosError as e:
+            if e.code != -2:
+                raise
+
+    async def release_lock(self) -> None:
+        if not self.lock_owned or self._releasing:
+            return
+        # _releasing gates BOTH duplicate cooperative releases and new
+        # ops starting mid-release (_ensure_lock waits on it): without
+        # it a write beginning during the awaits below would run
+        # unlocked behind the next owner's back
+        self._releasing = True
+        try:
+            # drain: the exclusivity contract means no write of OURS
+            # may still be in flight when the next owner starts — wait
+            # for guarded ops (ExclusiveLock pre-release hook role)
+            while self._lock_users:
+                self._idle_ev.clear()
+                await self._idle_ev.wait()
+            await self._save_object_map()
+            self.lock_owned = False
+            self._omap = None
+            self._omap_dirty = False
+            try:
+                await self.client.execute(
+                    self.pool_id, _header(self.name), "lock", "unlock",
+                    _enc_lock_input(LOCK_NAME, self.client.name,
+                                    self._lock_cookie))
+            except (KeyError, IOError):
+                pass  # already broken/stolen: nothing to release
+            if self._watch_cookie is not None:
+                try:
+                    await self.client.unwatch(
+                        self.pool_id, _header(self.name),
+                        self._watch_cookie)
+                except Exception:
+                    pass
+                self._watch_cookie = None
+        finally:
+            self._releasing = False
+
+    def _header_notify(self, _oid, _notify_id, payload) -> None:
+        """Watch callback: a peer wants the lock — release once the
+        in-flight guarded IO drains (cooperative transition)."""
+        if payload == NOTIFY_REQUEST_LOCK and self.lock_owned \
+                and not self._releasing:
+            asyncio.get_running_loop().create_task(self.release_lock())
+
+    async def _lock_holder(self) -> tuple[str, str] | None:
+        raw = await self.client.execute(
+            self.pool_id, _header(self.name), "lock", "get_info",
+            _enc_lock_input(LOCK_NAME))
+        ltype, off = denc.dec_str(raw, 0)
+        if ltype == "none":
+            return None
+
+        def one(b, o):
+            owner, o = denc.dec_str(b, o)
+            cookie, o = denc.dec_str(b, o)
+            return (owner, cookie), o
+
+        holders, _ = denc.dec_list(raw, off, one)
+        return holders[0] if holders else None
+
+    async def _ensure_lock(self) -> None:
+        if not self.exclusive:
+            return
+        while self._releasing:
+            # a cooperative handover is mid-flight: let it finish, then
+            # re-acquire — jumping in now would write behind the new
+            # owner's back
+            await asyncio.sleep(0.01)
+        if not self.lock_owned:
+            await self.acquire_lock()
+
+    def _io_guard(self) -> "_LockGuard":
+        """Async context every mutating op runs under: it pins the lock
+        (release waits for zero guards) so exclusivity holds across the
+        WHOLE op, not just its first await."""
+        return _LockGuard(self)
+
+    # --------------------------------------------------------- object map
+    #
+    # Two-state bits (ObjectMap.h OBJECT_EXISTS / OBJECT_PENDING role):
+    #   0 = nonexistent, 1 = exists (verified), 2 = pending (a write
+    #   was INTENDED; whether it landed is unknown).
+    # Invariants: a data write is preceded by a persisted >=pending bit
+    # (so remove() can trust 0 bits absolutely), and copy-up/flatten
+    # skip only on EXISTS (a pending bit proves nothing about content —
+    # trusting it after a crash mid-copy-up would detach the parent
+    # over a hole and silently lose data). Pending bits left behind by
+    # a crash are resolved by stat on the next load.
+
+    async def _load_object_map(self) -> None:
+        nobj = self._object_count()
+        try:
+            raw = await self.client.getxattr(
+                self.pool_id, _omap_oid(self.name), ATTR_OMAP_BITS)
+            bits = bytearray(raw)
+        except (KeyError, IOError):
+            bits = bytearray()
+        fresh = not bits and nobj > 0
+        if len(bits) != nobj:
+            old = bits
+            bits = bytearray(nobj)
+            bits[: min(len(old), nobj)] = old[: min(len(old), nobj)]
+        unknown = ([i for i in range(nobj)] if fresh
+                   else [i for i, b in enumerate(bits) if b == 2])
+        if unknown:
+            # resolve by stat: fresh map rebuild, or pending bits left
+            # by a crashed/fenced holder (rebuild-object-map role)
+            async def probe(i):
+                try:
+                    await self.client.stat(self.pool_id, self._oid(i))
+                    bits[i] = 1
+                except KeyError:
+                    bits[i] = 0
+            await asyncio.gather(*(probe(i) for i in unknown))
+        self._omap = bits
+        self._omap_dirty = fresh or bool(unknown)
+
+    async def _save_object_map(self) -> None:
+        if self._omap is None or not self._omap_dirty:
+            return
+        from ..cluster.client import ObjectOperation
+
+        op = (ObjectOperation()
+              .create(exclusive=False)
+              .setxattr(ATTR_OMAP_BITS, bytes(self._omap)))
+        await self.client.operate(
+            self.pool_id, _omap_oid(self.name), op)
+        self._omap_dirty = False
+
+    async def _omap_prewrite(self, objectnos) -> None:
+        """Mark every object an op is about to touch as PENDING and
+        persist ONCE before any data lands (one round trip per op, not
+        per object)."""
+        if self._omap is None:
+            return
+        changed = False
+        for objectno in objectnos:
+            if objectno >= len(self._omap):
+                self._omap.extend(
+                    bytearray(objectno + 1 - len(self._omap)))
+            if self._omap[objectno] == 0:
+                self._omap[objectno] = 2
+                changed = True
+        if changed:
+            self._omap_dirty = True
+            await self._save_object_map()
+
+    def _omap_settle(self, objectno: int, exists: bool) -> None:
+        """Record the VERIFIED outcome after the data op returned
+        (in-memory; persisted at the next save point — a crash loses
+        only the pending->exists refinement, which reloads via stat)."""
+        if self._omap is None:
+            return
+        if objectno >= len(self._omap):
+            self._omap.extend(bytearray(objectno + 1 - len(self._omap)))
+        want = 1 if exists else 0
+        if self._omap[objectno] != want:
+            self._omap[objectno] = want
+            self._omap_dirty = True
+
+    def object_map(self) -> bytes | None:
+        """Fast-diff surface: per-object state bytes (0 absent,
+        1 exists, 2 pending); None when not authoritative (lock not
+        held)."""
+        return bytes(self._omap) if self._omap is not None else None
 
     # ------------------------------------------------------------- meta
 
@@ -205,6 +523,11 @@ class Image:
 
     async def resize(self, new_size: int) -> None:
         self._writable()
+        await self._ensure_lock()
+        async with self._io_guard():
+            await self._resize_locked(new_size)
+
+    async def _resize_locked(self, new_size: int) -> None:
         old = self.size
         if new_size < old:
             # drop whole objects past the end, truncate the boundary one
@@ -227,6 +550,12 @@ class Image:
             denc.enc_u64(new_size),
         )
         self.size = new_size
+        if self._omap is not None:
+            nobj = self._object_count()
+            if len(self._omap) > nobj:
+                del self._omap[nobj:]
+                self._omap_dirty = True
+            await self._save_object_map()
 
     # --------------------------------------------------------------- io
 
@@ -244,26 +573,37 @@ class Image:
                 f"write past end of image ({offset + len(data)} > "
                 f"{self.size})"
             )
-        extents = file_to_extents(self.layout, offset, len(data),
-                                  _data_fmt(self.name))
+        await self._ensure_lock()
+        async with self._io_guard():
+            extents = file_to_extents(self.layout, offset, len(data),
+                                      _data_fmt(self.name))
+            await self._omap_prewrite(ex.objectno for ex in extents)
 
-        async def put(ex):
-            piece = bytearray(ex.length)
-            pos = 0
-            for bo, ln in ex.buffer_extents:
-                piece[pos : pos + ln] = data[bo : bo + ln]
-                pos += ln
-            await self._copy_up(ex.objectno)
-            await self.client.write(self.pool_id, ex.oid, ex.offset,
-                                    bytes(piece), snapc=self._snapc())
+            async def put(ex):
+                piece = bytearray(ex.length)
+                pos = 0
+                for bo, ln in ex.buffer_extents:
+                    piece[pos : pos + ln] = data[bo : bo + ln]
+                    pos += ln
+                await self._copy_up(ex.objectno)
+                await self.client.write(self.pool_id, ex.oid, ex.offset,
+                                        bytes(piece),
+                                        snapc=self._snapc())
+                self._omap_settle(ex.objectno, True)
 
-        await asyncio.gather(*(put(ex) for ex in extents))
+            await asyncio.gather(*(put(ex) for ex in extents))
 
     async def _copy_up(self, objectno: int) -> None:
         """Clone COW: first write to an object absent in the child
         copies the parent's data (read at the parent's RADOS snap id)
         up into the child (librbd CopyupRequest role)."""
         if self.parent is None:
+            return
+        if (self._omap is not None and objectno < len(self._omap)
+                and self._omap[objectno] == 1):
+            # EXISTS (verified): the child owns it, no stat needed.
+            # A PENDING bit proves nothing (a fenced holder may have
+            # died between marking and writing) — fall through to stat.
             return
         try:
             await self.client.stat(self.pool_id, self._oid(objectno))
@@ -277,10 +617,12 @@ class Image:
                                           snapid=self._parent_snapid)
         except KeyError:
             return  # parent hole: child object starts empty
+        await self._omap_prewrite((objectno,))
         await self.client.write_full(
             self.pool_id, self._oid(objectno), blob,
             snapc=self._snapc(),
         )
+        self._omap_settle(objectno, True)
 
     async def read(self, offset: int, length: int) -> bytes:
         length = max(0, min(length, self.size - offset))
@@ -326,15 +668,18 @@ class Image:
         ranges zero, whole objects could be removed — lite keeps
         zeroing uniform)."""
         self._writable()
-        extents = file_to_extents(self.layout, offset, length,
-                                  _data_fmt(self.name))
-        for ex in extents:
-            await self._copy_up(ex.objectno)
-            try:
-                await self.client.zero(self.pool_id, ex.oid, ex.offset,
-                                       ex.length, snapc=self._snapc())
-            except KeyError:
-                pass  # never written: already zero
+        await self._ensure_lock()
+        async with self._io_guard():
+            extents = file_to_extents(self.layout, offset, length,
+                                      _data_fmt(self.name))
+            for ex in extents:
+                await self._copy_up(ex.objectno)
+                try:
+                    await self.client.zero(
+                        self.pool_id, ex.oid, ex.offset, ex.length,
+                        snapc=self._snapc())
+                except KeyError:
+                    pass  # never written: already zero
 
     # ---------------------------------------------------------- objects
 
@@ -348,11 +693,19 @@ class Image:
                                      snapc=self._snapc())
         except KeyError:
             pass
+        self._omap_settle(objno, False)
 
     async def _remove_objects(self) -> None:
-        await asyncio.gather(*(
-            self._rm_object(i) for i in range(self._object_count())
-        ))
+        # fast-diff: only objects the map says MAY exist (exists or
+        # pending) need deleting; 0 bits are trustworthy because every
+        # data write is preceded by a persisted pending bit
+        which = (
+            [i for i in range(min(self._object_count(),
+                                  len(self._omap)))
+             if self._omap[i]]
+            if self._omap is not None
+            else range(self._object_count()))
+        await asyncio.gather(*(self._rm_object(i) for i in which))
 
     # -------------------------------------------------------- snapshots
     #
@@ -365,6 +718,7 @@ class Image:
 
     async def snap_create(self, snap: str) -> None:
         self._writable()
+        await self._ensure_lock()
         await self.refresh()
         if snap in self.snaps:
             raise ImageExists(f"{self.name}@{snap}")
@@ -385,6 +739,7 @@ class Image:
 
     async def snap_rollback(self, snap: str) -> None:
         self._writable()
+        await self._ensure_lock()
         await self.refresh()
         if snap not in self.snaps:
             raise KeyError(snap)
@@ -398,10 +753,14 @@ class Image:
             except KeyError:
                 await self._rm_object(objno)
                 return
+            await self._omap_prewrite((objno,))
             await self.client.write_full(self.pool_id, self._oid(objno),
                                          blob, snapc=self._snapc())
+            self._omap_settle(objno, True)
 
-        await asyncio.gather(*(rb(i) for i in range(self._object_count())))
+        async with self._io_guard():
+            await asyncio.gather(
+                *(rb(i) for i in range(self._object_count())))
 
     async def snap_list(self) -> list[str]:
         await self.refresh()
@@ -420,13 +779,16 @@ class Image:
 
     async def flatten(self) -> None:
         """Detach from the parent by copying up every still-shared
-        object (librbd flatten role)."""
+        object (librbd flatten role); the object map prunes the sweep
+        to objects the child does NOT yet own (fast-diff role)."""
         self._writable()
         if self.parent is None:
             return
-        await asyncio.gather(*(
-            self._copy_up(i) for i in range(self._object_count())
-        ))
-        await self.client.rmxattr(self.pool_id, _header(self.name),
-                                  ATTR_PARENT)
-        self.parent = None
+        await self._ensure_lock()
+        async with self._io_guard():
+            await asyncio.gather(*(
+                self._copy_up(i) for i in range(self._object_count())
+            ))
+            await self.client.rmxattr(self.pool_id, _header(self.name),
+                                      ATTR_PARENT)
+            self.parent = None
