@@ -710,7 +710,14 @@ class MEnvelope(Message):
         ("dst", "str"),
         ("mtype", "u32"),
         ("payload", "bytes"),
+        # per-ENTITY origin signature (CephxProtocol authorizer role):
+        # HMAC(src entity's key, src|dst|mtype|payload), verified by
+        # the receiving NetBus — the node-level connection handshake
+        # authenticates the PROCESS, this binds the claimed src entity
+        # to a key only that entity holds. Empty when auth is off.
+        ("sig", "bytes"),
     )
+    DEFAULTS = {"sig": b""}
 
 
 @register_message

@@ -344,6 +344,22 @@ class OSDLite:
 
         await mon_send(self.bus, self.name, msg, deadline_s)
 
+    async def _catchup_to(self, epoch: int,
+                          timeout: float = 5.0) -> None:
+        """Fetch maps until we reach ``epoch`` (bounded): the op that
+        quoted it proceeds only on a map at least that new."""
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + timeout
+        while self.epoch < epoch and loop.time() < deadline:
+            try:
+                await self.mon_send(M.MMonGetMap(have=self.epoch),
+                                    deadline_s=1.0)
+            except Exception:
+                pass
+            if self.epoch >= epoch:
+                return
+            await asyncio.sleep(0.02)
+
     async def start(self) -> None:
         self.stopped = False
         self.bus.register(self.name, self.handle)
@@ -577,6 +593,13 @@ class OSDLite:
         if tracked is not None:
             tracked.mark("dequeued")
         try:
+            if msg.epoch > self.epoch:
+                # the sender has a NEWER map (OSD::wait_for_new_map
+                # role): catch up before serving — that newer epoch may
+                # carry a blocklist entry this very op sequence relies
+                # on (a stolen lock's fence), so executing on the stale
+                # map would break the fence ordering
+                await self._catchup_to(msg.epoch)
             if (self.osdmap is not None
                     and src in self.osdmap.blocklist):
                 # fenced entity (OSDMap::is_blocklisted role): its ops

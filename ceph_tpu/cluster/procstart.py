@@ -49,10 +49,11 @@ class ProcCluster:
                         + [f"mon.{r}" for r in range(n_mons)]
                         + [f"osd.{i}" for i in range(n_osds)]
                         + [f"client.{i}" for i in range(4)]
-                        + ["node"])
-            # NetBus authenticates at PROCESS level (node.<pid>): every
-            # node shares one node key; entity keys cover the future
-            # per-entity caps story
+                        + ["mgr", "node"])
+            # the node key authenticates the PROCESS link; every
+            # envelope is additionally signed with its src ENTITY's key
+            # (netbus._env_sig) so one authenticated process cannot
+            # speak as another's entities
             make_keyring(self.book, entities)
         self.procs: dict[str, subprocess.Popen | None] = {}
         self.bus: NetBus | None = None

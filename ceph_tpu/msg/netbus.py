@@ -33,10 +33,21 @@ import os
 from typing import Awaitable, Callable
 
 from ..cluster.messages import MEnvelope
+from .auth import _mac
 from .messages import decode_message
 from .messenger import SendError, TcpMessenger
 
 Dispatcher = Callable[[str, object], Awaitable[None]]
+
+
+def _env_sig(key: bytes, src: str, dst: str, mtype: int,
+             payload: bytes) -> bytes:
+    """Entity-origin envelope signature (truncated HMAC-SHA256). Binds
+    the claimed src ENTITY to its keyring secret over the full routed
+    content; replay is the message layer's concern (tids/epochs — and
+    secure mode's per-record nonces on the wire)."""
+    return _mac(key, src.encode(), dst.encode(),
+                mtype.to_bytes(4, "little"), payload)[:16]
 
 
 class NetBus:
@@ -50,9 +61,12 @@ class NetBus:
         #: instead, so this only gates outgoing sends
         self.blackholes: set[str] = set()
         # one shared node identity: the cephx handshake authenticates
-        # the PROCESS link (entity-level identity rides the envelope);
-        # a fixed name lets every node share one keyring entry
+        # the PROCESS link; entity-level identity rides the envelope
+        # and is SIGNED per entity (see _env_sig) — a process that
+        # only holds the node key cannot claim to be "mon" or osd.N.
+        # A fixed name lets every node share one keyring entry.
         self._node = "node"
+        self._keys = keys
         self._tcp = TcpMessenger(self._node, self._dispatch, keys=keys,
                                  secure=secure)
         self._addr: tuple[str, int] | None = None
@@ -152,8 +166,16 @@ class NetBus:
     async def send(self, src: str, dst: str, msg) -> None:
         if dst in self.blackholes or src in self.blackholes:
             return
+        payload = msg.encode()
+        sig = b""
+        if self._keys is not None:
+            key = self._keys.get(src)
+            if key is None:
+                raise SendError(
+                    f"no key for entity {src!r}: cannot sign envelope")
+            sig = _env_sig(key, src, dst, msg.TYPE, payload)
         env = MEnvelope(src=src, dst=dst, mtype=msg.TYPE,
-                        payload=msg.encode())
+                        payload=payload, sig=sig)
         local = self.entities.get(dst)
         if local is not None:
             # same-process delivery: scheduled, never inline (the
@@ -182,6 +204,19 @@ class NetBus:
     async def _dispatch(self, _node_src: str, env) -> None:
         if not isinstance(env, MEnvelope):
             return  # stray non-envelope frame: drop
+        if self._keys is not None:
+            # per-entity origin check (CephxProtocol authorizer role):
+            # the connection is node-authenticated, but the src ENTITY
+            # must prove itself with its own key — otherwise any
+            # process on the node keyring could impersonate the mon
+            import hmac as _hmac
+
+            key = self._keys.get(env.src)
+            if key is None or not _hmac.compare_digest(
+                env.sig,
+                _env_sig(key, env.src, env.dst, env.mtype, env.payload),
+            ):
+                return  # unsigned/forged origin: drop
         handler = self.entities.get(env.dst)
         if handler is None:
             return  # entity moved/died after the sender resolved it

@@ -142,6 +142,14 @@ class RBD:
             if "-17" in str(e):
                 raise ImageExists(name) from None
             raise
+        # seed an all-absent object map: the image is known empty here,
+        # which spares the first lock holder the full stat sweep the
+        # fresh-map rebuild would otherwise run (fast-diff from byte 0)
+        nobj = -(-size // layout.object_size) if size else 0
+        seed = (ObjectOperation()
+                .create(exclusive=False)
+                .setxattr(ATTR_OMAP_BITS, bytes(nobj)))
+        await self.client.operate(self.pool_id, _omap_oid(name), seed)
 
     async def open(self, name: str, snap: str | None = None) -> "Image":
         img = Image(self.client, self.pool_id, name, snap=snap)
@@ -161,7 +169,8 @@ class RBD:
         if img.snaps:
             raise RuntimeError(f"image {name} has snapshots")
         await img.acquire_lock()  # loads/rebuilds the object map
-        await img._remove_objects()
+        async with img._io_guard():
+            await img._remove_objects()
         await img.release_lock()
         try:
             await self.client.delete(self.pool_id, _omap_oid(name))
@@ -227,6 +236,7 @@ class Image:
         #: lock changes hands (exclusivity across whole ops)
         self._lock_users = 0
         self._idle_ev = asyncio.Event()
+        self._acquire_mu = asyncio.Lock()
 
     # ----------------------------------------------------- exclusive lock
 
@@ -243,8 +253,15 @@ class Image:
         holder's late writes bounce EBLOCKLISTED at every OSD."""
         from ..cluster.client import RadosError
 
-        if self.lock_owned or self.snap is not None:
+        if self.snap is not None:
             return
+        async with self._acquire_mu:
+            if self.lock_owned:
+                return
+            await self._acquire_locked(timeout, steal_dead, RadosError)
+
+    async def _acquire_locked(self, timeout, steal_dead,
+                              RadosError) -> None:
         loop = asyncio.get_running_loop()
         deadline = loop.time() + timeout
         last_holder: tuple[str, str] | None = None
@@ -280,11 +297,15 @@ class Image:
                     raise LockBusy(f"{self.name}: lock held by "
                                    f"{holder[0]}/{holder[1]}")
                 await self._steal_lock(holder)
-        self.lock_owned = True
+        # the map and watch must be READY before lock_owned flips: a
+        # concurrent op passing _ensure_lock the instant the flag turns
+        # would otherwise write with _omap None, skipping the persisted
+        # pending bit remove() trusts
         await self._load_object_map()
         if self._watch_cookie is None:
             self._watch_cookie = await self.client.watch(
                 self.pool_id, _header(self.name), self._header_notify)
+        self.lock_owned = True
 
     async def _steal_lock(self, holder: tuple[str, str]) -> None:
         """Fence-then-break (ExclusiveLock break_lock + blocklist):
@@ -294,8 +315,14 @@ class Image:
         from ..cluster.client import RadosError
 
         owner, _cookie = holder
-        if owner != self.client.name:
-            await self.client.blocklist_add(owner)
+        if owner == self.client.name:
+            # our own other handle holds it and is not releasing: a
+            # steal cannot be made safe (fencing the entity would fence
+            # US too) — surface it instead of running two writers
+            raise LockBusy(
+                f"{self.name}: lock held by another handle of "
+                f"{owner}; release it there")
+        await self.client.blocklist_add(owner)
         try:
             await self.client.execute(
                 self.pool_id, _header(self.name), "lock", "break_lock",
@@ -376,6 +403,9 @@ class Image:
             await asyncio.sleep(0.01)
         if not self.lock_owned:
             await self.acquire_lock()
+            if self._omap is None and self.snap is None:
+                # paranoia tripwire for the acquire/ensure contract
+                raise RuntimeError("lock acquired without object map")
 
     def _io_guard(self) -> "_LockGuard":
         """Async context every mutating op runs under: it pins the lock
@@ -719,27 +749,35 @@ class Image:
     async def snap_create(self, snap: str) -> None:
         self._writable()
         await self._ensure_lock()
-        await self.refresh()
-        if snap in self.snaps:
-            raise ImageExists(f"{self.name}@{snap}")
-        snapid = await self.client.selfmanaged_snap_create(self.pool_id)
-        self.snaps.append(snap)
-        self.snap_ids[snap] = snapid
-        self.snap_seq = max(self.snap_seq, snapid)
-        await self._save_snaps()
+        async with self._io_guard():
+            await self.refresh()
+            if snap in self.snaps:
+                raise ImageExists(f"{self.name}@{snap}")
+            snapid = await self.client.selfmanaged_snap_create(
+                self.pool_id)
+            self.snaps.append(snap)
+            self.snap_ids[snap] = snapid
+            self.snap_seq = max(self.snap_seq, snapid)
+            await self._save_snaps()
 
     async def snap_remove(self, snap: str) -> None:
-        await self.refresh()
-        if snap not in self.snaps:
-            raise KeyError(snap)
-        snapid = self.snap_ids.pop(snap)
-        self.snaps.remove(snap)
-        await self._save_snaps()
+        await self._ensure_lock()
+        async with self._io_guard():
+            await self.refresh()
+            if snap not in self.snaps:
+                raise KeyError(snap)
+            snapid = self.snap_ids.pop(snap)
+            self.snaps.remove(snap)
+            await self._save_snaps()
         await self.client.selfmanaged_snap_remove(self.pool_id, snapid)
 
     async def snap_rollback(self, snap: str) -> None:
         self._writable()
         await self._ensure_lock()
+        async with self._io_guard():
+            await self._rollback_locked(snap)
+
+    async def _rollback_locked(self, snap: str) -> None:
         await self.refresh()
         if snap not in self.snaps:
             raise KeyError(snap)
@@ -758,9 +796,8 @@ class Image:
                                          blob, snapc=self._snapc())
             self._omap_settle(objno, True)
 
-        async with self._io_guard():
-            await asyncio.gather(
-                *(rb(i) for i in range(self._object_count())))
+        await asyncio.gather(
+            *(rb(i) for i in range(self._object_count())))
 
     async def snap_list(self) -> list[str]:
         await self.refresh()
