@@ -1,31 +1,38 @@
-"""MgrLite: stats aggregation, health, and metrics export (the
-src/mgr DaemonServer/ClusterState role plus the prometheus module +
-src/exporter role).
+"""MgrLite: stats aggregation, health, and the loadable-module host
+(the src/mgr DaemonServer/ClusterState + ActivePyModules roles).
 
 Daemons push MMgrReport on their heartbeat cadence (perf-dump JSON +
-per-PG state counts); the mgr keeps the latest report per OSD, serves
-cluster status / health checks, and renders a Prometheus text
-exposition. Health mirrors the reference's checks it can see:
-OSD_DOWN (map), PG_NOT_ACTIVE (reports), MGR_STALE_REPORTS (silence).
-All surfaces are exposed on an admin socket ('ceph status' /
-'ceph health' / exporter scrape roles).
+per-PG state counts); the mgr keeps the latest report per OSD and
+serves cluster status / health checks. Everything beyond that runs AS A
+MODULE against the MgrModule API (cluster/mgr_module.py): prometheus,
+balancer, and pg_autoscaler are built-in modules in
+ceph_tpu/mgr_modules/ — the same drop-in file format third-party
+modules use via ``load_modules_from(dir)``. Module commands are served
+on the admin socket next to the host's own status/health verbs.
+
+Health mirrors the reference's checks it can see: OSD_DOWN (map),
+PG_NOT_ACTIVE (reports), MGR_STALE_REPORTS (silence).
 """
 from __future__ import annotations
 
 import asyncio
 import json
+import sys
 import time
 
 from ..utils.admin import AdminSocket
 from . import messages as M
+from .mgr_module import ModuleHost
 
 HEALTH_OK = "HEALTH_OK"
 HEALTH_WARN = "HEALTH_WARN"
 HEALTH_ERR = "HEALTH_ERR"
 
 
-class MgrLite:
-    def __init__(self, bus, mon, stale_secs: float = 5.0):
+class MgrLite(ModuleHost):
+    def __init__(self, bus, mon, stale_secs: float = 5.0,
+                 builtin_modules: bool = True):
+        ModuleHost.__init__(self)
         self.bus = bus
         self.mon = mon
         self.name = "mgr"
@@ -34,6 +41,13 @@ class MgrLite:
         self.config_mirror: dict[str, str] = {}  # "who/key" -> value
         self.admin: AdminSocket | None = None
         self._sub_task: asyncio.Task | None = None
+        self._running = False
+        self._last_epoch = 0
+        if builtin_modules:
+            from ..mgr_modules import BUILTIN
+
+            for name, cls in BUILTIN.items():
+                self.load_module(name, cls)
 
     # ---------------------------------------------------------- lifecycle
 
@@ -44,6 +58,8 @@ class MgrLite:
         # periodic idempotent re-subscribe is the liveness mechanism
         self._sub_task = asyncio.get_running_loop().create_task(
             self._subscribe_loop())
+        self._running = True
+        self._start_all_modules()
 
     async def _subscribe_loop(self) -> None:
         while True:
@@ -55,6 +71,8 @@ class MgrLite:
             await asyncio.sleep(1.0)
 
     async def stop(self) -> None:
+        await self._stop_all_modules()
+        self._running = False
         self.bus.unregister(self.name)
         if self._sub_task is not None:
             self._sub_task.cancel()
@@ -73,67 +91,78 @@ class MgrLite:
                       "cluster status (ceph -s role)")
         sock.register("health", lambda a: self.health(),
                       "health checks")
-        sock.register("prometheus", lambda a: self.render_prometheus(),
-                      "metrics exposition text")
         sock.register("config set", self._admin_config_set,
                       "central config: {who, key, value}")
         sock.register("config dump", lambda a: self.config_mirror,
                       "central config DB contents")
-        sock.register("balancer status", self._admin_balancer_status,
-                      "PG distribution for a pool: {pool}")
-        sock.register("balancer run", self._admin_balancer_run,
-                      "apply upmap moves: {pool, max_moves?}")
-        sock.register("autoscaler run", self._admin_autoscaler_run,
-                      "one pg_autoscaler round: {target_per_osd?}")
+        sock.register("mgr modules", lambda a: sorted(self.modules),
+                      "loaded mgr modules")
+        # every module command rides the same socket (MonCommand role)
+        for cmd, (_mod, desc) in sorted(self._commands.items()):
+            sock.register(
+                cmd,
+                lambda a, _c=cmd: self.dispatch_command(_c, a or {}),
+                desc)
         await sock.start()
         self.admin = sock
 
-    # -------------------------------------------- config / balancer verbs
+    def _command_added(self, cmd: str, desc: str) -> None:
+        if self.admin is not None:
+            self.admin.register(
+                cmd,
+                lambda a, _c=cmd: self.dispatch_command(_c, a or {}),
+                desc)
 
     async def _admin_config_set(self, args: dict):
         await self.bus.send(self.name, "mon", M.MConfigSet(
             who=args["who"], key=args["key"], value=args["value"]))
         return "ok"
 
-    async def _admin_balancer_status(self, args: dict):
-        from . import balancer
+    # --------------------------------------------- ModuleHost services
 
-        return balancer.spread(self.mon.osdmap, int(args["pool"]))
+    def _started(self) -> bool:
+        return self._running
 
-    async def _admin_balancer_run(self, args: dict):
-        """One balancer round (the `ceph balancer execute` arc): plan
-        upmap moves, commit each through the mon, report the plan."""
-        from . import balancer
+    def module_get(self, what: str):
+        if what == "osd_map":
+            return self.mon.osdmap
+        if what == "reports":
+            return self.reports
+        if what == "status":
+            return self.status()
+        if what == "health":
+            return self.health()
+        raise KeyError(f"mgr get({what!r}) not served")
 
-        pool = int(args["pool"])
-        before = balancer.spread(self.mon.osdmap, pool)
-        moves = balancer.compute_moves(
-            self.mon.osdmap, pool, int(args.get("max_moves", 10)))
-        if moves:  # the whole plan rides one message -> one map epoch
-            await self.bus.send(self.name, "mon",
-                                M.MUpmapItems(entries=moves))
-        return {"moves": [
-            {"pgid": list(p), "pairs": [list(x) for x in pr]}
-            for p, pr in moves],
-            "before": before}
+    def module_get_store(self, module: str, key: str, default):
+        # the mon's central config DB under who="mgr" is the module KV
+        # (MonKVStore role); empty string encodes a deleted key
+        val = self.config_mirror.get(f"mgr/{module}/{key}")
+        return default if not val else val
 
-    async def _admin_autoscaler_run(self, args: dict):
-        return await self.autoscale_once(
-            int(args.get("target_per_osd", 100)))
+    async def module_set_store(self, module: str, key: str,
+                               value: str | None) -> None:
+        full_key = f"{module}/{key}"
+        self.config_mirror[f"mgr/{full_key}"] = value or ""
+        await self.bus.send(self.name, "mon", M.MConfigSet(
+            who="mgr", key=full_key, value=value or ""))
+
+    async def module_send_mon(self, msg) -> None:
+        await self.bus.send(self.name, "mon", msg)
+
+    def module_log(self, module: str, msg: str) -> None:
+        print(f"[mgr.{module}] {msg}", file=sys.stderr)
+
+    # ---------------------------------------------- back-compat surface
 
     async def autoscale_once(self, target_per_osd: int = 100) -> dict:
-        """One pg_autoscaler round (module.py:706 role): plan pg_num /
-        pgp_num growth from the map, submit each change to the mon.
-        pgp_num trails pg_num by one round so member-local collection
-        splits complete before placement changes."""
-        from . import autoscaler
+        """One pg_autoscaler round (kept as a host method; the logic
+        lives in the pg_autoscaler module)."""
+        return await self.modules["pg_autoscaler"].run_once(
+            target_per_osd)
 
-        actions = autoscaler.plan(self.mon.osdmap, target_per_osd)
-        for pool_id, key, value in actions:
-            await self.bus.send(
-                self.name, "mon",
-                M.MPoolSet(pool_id=pool_id, key=key, value=value))
-        return {"actions": [list(a) for a in actions]}
+    def render_prometheus(self) -> str:
+        return self.modules["prometheus"].render()
 
     async def handle(self, src: str, msg) -> None:
         if isinstance(msg, M.MMgrReport):
@@ -143,6 +172,11 @@ class MgrLite:
                 "perf": json.loads(msg.perf.decode() or "{}"),
                 "pgs": dict(msg.pgs),
             }
+            self.notify_all("reports", msg.osd)
+            epoch = self.mon.osdmap.epoch
+            if epoch != self._last_epoch:
+                self._last_epoch = epoch
+                self.notify_all("osd_map", epoch)
         elif isinstance(msg, M.MConfig):
             self.config_mirror = {
                 f"{w}/{k}": v for w, k, v in msg.entries}
@@ -166,6 +200,7 @@ class MgrLite:
             "pools": len(osdmap.pools),
             "pgs": pg_states,
             "client_ops_total": ops,
+            "mgr_modules": sorted(self.modules),
         }
 
     def health(self) -> dict:
@@ -195,37 +230,3 @@ class MgrLite:
             checks["PG_NOT_ACTIVE"] = f"{inactive} pg instances not active"
         status = HEALTH_OK if not checks else HEALTH_WARN
         return {"status": status, "checks": checks}
-
-    def render_prometheus(self) -> str:
-        """Exposition text (prometheus mgr module / src/exporter role)."""
-        lines = [
-            "# HELP ceph_osd_up OSD liveness per the cluster map",
-            "# TYPE ceph_osd_up gauge",
-        ]
-        osdmap = self.mon.osdmap
-        for i, o in enumerate(osdmap.osds):
-            lines.append(f'ceph_osd_up{{osd="{i}"}} {1 if o.up else 0}')
-        lines.append("# TYPE ceph_osd_op_total counter")
-        for osd, rep in sorted(self.reports.items()):
-            for key, val in sorted(rep["perf"].items()):
-                if isinstance(val, (int, float)):
-                    lines.append(
-                        f'ceph_osd_{key}_total{{osd="{osd}"}} {val}'
-                    )
-                elif isinstance(val, dict) and "sum" in val \
-                        and "avgcount" in val:
-                    lines.append(
-                        f'ceph_osd_{key}_sum{{osd="{osd}"}} {val["sum"]}'
-                    )
-                    lines.append(
-                        f'ceph_osd_{key}_count{{osd="{osd}"}} '
-                        f'{val["avgcount"]}'
-                    )
-        lines.append("# TYPE ceph_pg_states gauge")
-        states: dict[str, int] = {}
-        for rep in self.reports.values():
-            for s, n in rep["pgs"].items():
-                states[s] = states.get(s, 0) + n
-        for s, n in sorted(states.items()):
-            lines.append(f'ceph_pg_states{{state="{s}"}} {n}')
-        return "\n".join(lines) + "\n"

@@ -1,0 +1,21 @@
+"""Built-in mgr modules (the src/pybind/mgr/<module>/ role).
+
+Each module is a standalone file against the MgrModule API
+(cluster/mgr_module.py) — the same format third-party drop-ins use, so
+the builtins double as the reference examples. MgrLite loads them at
+construction; `ceph_tpu.cluster.mgr_module.load_module_file` loads
+external ones from any directory.
+"""
+from __future__ import annotations
+
+from .balancer import Module as BalancerModule
+from .pg_autoscaler import Module as PgAutoscalerModule
+from .prometheus import Module as PrometheusModule
+from .rgw_lc import Module as RgwLcModule
+
+BUILTIN = {
+    "balancer": BalancerModule,
+    "pg_autoscaler": PgAutoscalerModule,
+    "prometheus": PrometheusModule,
+    "rgw_lc": RgwLcModule,
+}

@@ -126,19 +126,66 @@ def _data_oid(bucket: str, key: str) -> str:
     return f"{bucket}//{key}"
 
 
+def _ver_oid(bucket: str, key: str, vid: str) -> str:
+    return f"{bucket}//{key}.__v.{vid}"
+
+
+#: version-index rows live right after their plain key in the sorted
+#: omap: "key\0v<order>"; order = inverted nanoseconds hex so the
+#: NEWEST version sorts (and lists) first, the S3 ListObjectVersions
+#: order. "\0" cannot appear in S3 keys, so the namespace is disjoint.
+_VSEP = "\x00v"
+
+
+def _ver_index_key(key: str, order: str) -> str:
+    return f"{key}{_VSEP}{order}"
+
+
+def _is_ver_index_key(key: str) -> bool:
+    return _VSEP in key
+
+
+def _new_vid(now: float) -> str:
+    """Version id = inverted-nanoseconds hex (newest sorts first) plus
+    a random suffix; the WHOLE id is the version-row sort key, so two
+    puts in the same clock quantum still get distinct rows."""
+    import secrets as _secrets
+
+    return (format((1 << 63) - int(now * 1e9), "016x")
+            + _secrets.token_hex(4))
+
+
+def _null_order(mtime: float) -> str:
+    """Row key for a preserved pre-versioning ("null") object, derived
+    from its mtime so it sorts into the version timeline where it
+    belongs."""
+    return format((1 << 63) - int(mtime * 1e9), "016x") + "00000000"
+
+
 def _enc_entry(size: int, etag: str, mtime: float,
-               multipart: bool = False) -> bytes:
+               multipart: bool = False, vid: str = "",
+               marker: bool = False) -> bytes:
+    """Index entry: size/etag/mtime/multipart plus the versioning
+    fields (rgw_bucket_dir_entry role): ``vid`` names the version the
+    entry points at ("" = unversioned/null version at the plain data
+    oid) and ``marker`` flags an S3 delete marker."""
     return (denc.enc_u64(size) + denc.enc_str(etag)
-            + denc.enc_u64(int(mtime)) + denc.enc_u8(multipart))
+            + denc.enc_u64(int(mtime)) + denc.enc_u8(multipart)
+            + denc.enc_str(vid) + denc.enc_u8(marker))
 
 
 def _dec_entry(b: bytes) -> dict:
     size, off = denc.dec_u64(b, 0)
     etag, off = denc.dec_str(b, off)
     mtime, off = denc.dec_u64(b, off)
-    multipart, _ = denc.dec_u8(b, off)
+    multipart, off = denc.dec_u8(b, off)
+    vid, marker = "", 0
+    if off < len(b):  # entries written before versioning lack these
+        vid, off = denc.dec_str(b, off)
+        marker, off = denc.dec_u8(b, off)
     return {"size": size, "etag": etag, "mtime": mtime,
-            "multipart": bool(multipart)}
+            "multipart": bool(multipart), "version_id": vid,
+            "delete_marker": bool(marker)}
 
 
 class _ClsIndex:
@@ -251,12 +298,91 @@ class RGWLite:
         if bucket.encode() not in await self._buckets():
             raise RGWError("NoSuchBucket", 404)
 
+    # --------------------------------------------------------- versioning
+
+    ATTR_VERSIONING = "rgw.versioning"
+    ATTR_LIFECYCLE = "rgw.lifecycle"
+
+    async def put_bucket_versioning(self, bucket: str,
+                                    status: str) -> None:
+        """Enable/suspend versioning (rgw_op.cc RGWSetBucketVersioning
+        role); status is "Enabled" or "Suspended"."""
+        if status not in ("Enabled", "Suspended"):
+            raise RGWError("IllegalVersioningConfigurationException")
+        await self._require_bucket(bucket)
+        await self.client.setxattr(self.pool_id, _index_oid(bucket),
+                                   self.ATTR_VERSIONING, status.encode())
+
+    async def get_bucket_versioning(self, bucket: str) -> str:
+        await self._require_bucket(bucket)
+        try:
+            raw = await self.client.getxattr(
+                self.pool_id, _index_oid(bucket), self.ATTR_VERSIONING)
+            return raw.decode()
+        except (KeyError, IOError):
+            return ""  # never configured (S3: empty config)
+
+    async def _versioning_enabled(self, bucket: str) -> bool:
+        return await self.get_bucket_versioning(bucket) == "Enabled"
+
+    async def list_object_versions(self, bucket: str, prefix: str = "",
+                                   max_keys: int = 1000) -> list[dict]:
+        """All versions + delete markers, newest first per key
+        (ListObjectVersions role). The current pointer decides
+        is_latest."""
+        await self._require_bucket(bucket)
+        out: list[dict] = []
+        marker = ""
+        current: dict[str, str] = {}
+        while len(out) < max_keys:
+            page, truncated = await self.index.list(
+                bucket, prefix, marker, 1000)
+            if not page:
+                break
+            for ent in page:
+                k = ent["key"]
+                marker = k
+                if not _is_ver_index_key(k):
+                    current[k] = ent["version_id"]
+                    if not ent["version_id"] and not ent["delete_marker"]:
+                        # pre-versioning ("null") object: it IS a
+                        # version in S3 terms
+                        ent["is_latest"] = True
+                        out.append(ent)
+                    continue
+                key = k.split(_VSEP, 1)[0]
+                ent["key"] = key
+                ent["is_latest"] = \
+                    current.get(key) == ent["version_id"]
+                out.append(ent)
+            if not truncated:
+                break
+        return out[:max_keys]
+
     # ------------------------------------------------------------ objects
 
     async def put_object(self, bucket: str, key: str,
-                         data: bytes) -> str:
+                         data: bytes) -> str | tuple[str, str]:
+        """Returns the etag; on a versioning-enabled bucket returns
+        (etag, version_id)."""
         await self._require_bucket(bucket)
         etag = hashlib.md5(data).hexdigest()
+        if "\x00" in key:
+            # the version-row namespace relies on NUL never appearing
+            # in keys (true for real S3 too: XML cannot carry it)
+            raise RGWError("InvalidObjectName")
+        if await self._versioning_enabled(bucket):
+            now = time.time()
+            vid = _new_vid(now)
+            await self._preserve_null_version(bucket, key)
+            await self.client.write_full(
+                self.pool_id, _ver_oid(bucket, key, vid), data)
+            entry = _enc_entry(len(data), etag, now, vid=vid)
+            # the version row, then the current pointer
+            await self.index.put(bucket, _ver_index_key(key, vid),
+                                 entry)
+            await self.index.put(bucket, key, entry)
+            return etag, vid
         oid = _data_oid(bucket, key)
         if len(data) > STRIPE_THRESHOLD:
             await self.striper.write(oid, data)
@@ -267,8 +393,33 @@ class RGWLite:
                              _enc_entry(len(data), etag, time.time()))
         return etag
 
-    async def get_object(self, bucket: str, key: str) -> tuple[bytes, dict]:
-        meta = await self.head_object(bucket, key)
+    async def _preserve_null_version(self, bucket: str,
+                                     key: str) -> None:
+        """A pre-versioning object about to be shadowed by a versioned
+        write/marker becomes the addressable "null" version (S3 keeps
+        it; its data stays at the plain oid)."""
+        try:
+            cur = await self.index.get(bucket, key)
+        except RGWError:
+            return
+        if cur["version_id"] or cur["delete_marker"]:
+            return  # already versioned / already preserved
+        row = _enc_entry(cur["size"], cur["etag"], cur["mtime"],
+                         multipart=cur["multipart"], vid="null")
+        await self.index.put(
+            bucket, _ver_index_key(key, _null_order(cur["mtime"])),
+            row)
+
+    async def get_object(self, bucket: str, key: str,
+                         version_id: str = "") -> tuple[bytes, dict]:
+        meta = await self.head_object(bucket, key, version_id)
+        if meta["delete_marker"]:
+            raise RGWError("NoSuchKey", 404)  # named marker version
+        if meta["version_id"] and meta["version_id"] != "null":
+            data = await self.client.read(
+                self.pool_id,
+                _ver_oid(bucket, key, meta["version_id"]))
+            return data, meta
         oid = _data_oid(bucket, key)
         if meta["multipart"]:
             data = await self._read_multipart(bucket, key)
@@ -278,9 +429,55 @@ class RGWLite:
             data = await self.client.read(self.pool_id, oid)
         return data, meta
 
-    async def head_object(self, bucket: str, key: str) -> dict:
+    async def head_object(self, bucket: str, key: str,
+                          version_id: str = "") -> dict:
         await self._require_bucket(bucket)
-        return await self.index.get(bucket, key)
+        if version_id:
+            ent = await self._find_version(bucket, key, version_id)
+            if ent is None:
+                raise RGWError("NoSuchVersion", 404)
+            return ent
+        ent = await self.index.get(bucket, key)
+        if ent["delete_marker"]:
+            # the current IS a delete marker: the key reads as absent
+            # on every un-versioned access, HEAD included
+            raise RGWError("NoSuchKey", 404)
+        return ent
+
+    async def _find_version(self, bucket: str, key: str,
+                            vid: str) -> dict | None:
+        if vid == "null":
+            # the preserved pre-versioning object: either still the
+            # plain current (vid "") or a preserved "null" row — a
+            # bounded scan of the key's version rows finds it
+            try:
+                cur = await self.index.get(bucket, key)
+                if not cur["version_id"] and not cur["delete_marker"]:
+                    cur["key"] = key
+                    cur["version_id"] = "null"
+                    return cur
+            except RGWError:
+                pass
+            page, _tr = await self.index.list(
+                bucket, key + _VSEP, "", 1000)
+            for ent in page:
+                if ent["key"].split(_VSEP, 1)[0] != key:
+                    break
+                if ent["version_id"] == "null":
+                    ent["key"] = key
+                    return ent
+            return None
+        # the vid IS the row's sort component: addressed directly
+        try:
+            ent = _dec_entry(await self.client.execute(
+                self.pool_id, _index_oid(bucket), "rgw", "index_get",
+                denc.enc_bytes(_ver_index_key(key, vid).encode())))
+        except (KeyError, IOError):
+            return None
+        if ent["version_id"] != vid:
+            return None
+        ent["key"] = key
+        return ent
 
     async def bucket_stats(self, bucket: str) -> dict:
         """Server-maintained bucket accounting (cls_rgw stats role):
@@ -289,8 +486,61 @@ class RGWLite:
         await self._require_bucket(bucket)
         return await self.index.stats(bucket)
 
-    async def delete_object(self, bucket: str, key: str) -> None:
+    async def delete_object(self, bucket: str, key: str,
+                            version_id: str = "") -> str:
+        """S3 delete semantics (rgw_op.cc RGWDeleteObj versioned
+        paths). Unversioned bucket: remove data + entry. Versioned, no
+        version_id: insert a DELETE MARKER as the new current (data
+        untouched) and return its version id. With version_id: remove
+        exactly that version; if it was current, promote the next-
+        newest version (or marker) to current."""
+        await self._require_bucket(bucket)
+        versioned = await self.get_bucket_versioning(bucket) != ""
+        if versioned and not version_id:
+            now = time.time()
+            vid = _new_vid(now)
+            await self._preserve_null_version(bucket, key)
+            entry = _enc_entry(0, "", now, vid=vid, marker=True)
+            await self.index.put(bucket, _ver_index_key(key, vid),
+                                 entry)
+            await self.index.put(bucket, key, entry)
+            return vid
+        if versioned and version_id:
+            ent = await self._find_version(bucket, key, version_id)
+            if ent is None:
+                raise RGWError("NoSuchVersion", 404)
+            if ent["version_id"] == "null":
+                # the preserved pre-versioning object: its data lives
+                # in the PLAIN oid forms
+                await self._delete_plain_data(bucket, key, ent)
+                row = _ver_index_key(key, _null_order(ent["mtime"]))
+                await self.index.delete(bucket, row)
+            else:
+                if not ent["delete_marker"]:
+                    try:
+                        await self.client.delete(
+                            self.pool_id,
+                            _ver_oid(bucket, key, version_id))
+                    except KeyError:
+                        pass
+                await self.index.delete(
+                    bucket, _ver_index_key(key, version_id))
+            try:
+                cur = await self.index.get(bucket, key)
+            except RGWError:
+                return version_id
+            if cur["version_id"] == ent["version_id"] or (
+                    version_id == "null" and not cur["version_id"]):
+                await self._promote_newest(bucket, key)
+            return version_id
+        # unversioned bucket
         meta = await self.head_object(bucket, key)
+        await self._delete_plain_data(bucket, key, meta)
+        await self.index.delete(bucket, key)
+        return ""
+
+    async def _delete_plain_data(self, bucket: str, key: str,
+                                 meta: dict) -> None:
         oid = _data_oid(bucket, key)
         if meta["multipart"]:
             await self._delete_multipart(bucket, key)
@@ -301,7 +551,23 @@ class RGWLite:
                 await self.client.delete(self.pool_id, oid)
             except KeyError:
                 pass
-        await self.index.delete(bucket, key)
+
+    async def _promote_newest(self, bucket: str, key: str) -> None:
+        """The current version was deleted: the newest remaining
+        version row (they sort newest-first) becomes current; none
+        left -> the key disappears."""
+        page, _tr = await self.index.list(
+            bucket, key + _VSEP, "", 1)
+        if page and page[0]["key"].split(_VSEP, 1)[0] == key:
+            ent = page[0]
+            await self.index.put(
+                bucket, key,
+                _enc_entry(ent["size"], ent["etag"], ent["mtime"],
+                           multipart=ent["multipart"],
+                           vid=ent["version_id"],
+                           marker=ent["delete_marker"]))
+        else:
+            await self.index.delete(bucket, key)
 
     async def copy_object(self, src_bucket: str, src_key: str,
                           dst_bucket: str, dst_key: str) -> str:
@@ -312,9 +578,114 @@ class RGWLite:
                            marker: str = "", max_keys: int = 1000):
         """(entries, truncated) in lexicographic key order, filtered
         SERVER-SIDE by the cls_rgw index_list method (ListObjectsV2
-        role) — the wire carries one page, not the whole bucket."""
+        role) — the wire carries one page, not the whole bucket.
+        Version rows and delete-marker currents are invisible here
+        (the S3 non-versioned listing view)."""
         await self._require_bucket(bucket)
-        return await self.index.list(bucket, prefix, marker, max_keys)
+        out: list[dict] = []
+        truncated = True
+        while len(out) < max_keys and truncated:
+            page, truncated = await self.index.list(
+                bucket, prefix, marker, max_keys)
+            if not page:
+                break
+            for ent in page:
+                marker = ent["key"]
+                if _is_ver_index_key(ent["key"]) \
+                        or ent["delete_marker"]:
+                    continue
+                out.append(ent)
+                if len(out) == max_keys:
+                    # more rows may remain: report truncation so the
+                    # caller pages on (its marker = last key returned)
+                    truncated = True
+                    break
+        return out, truncated
+
+    # ---------------------------------------------------------- lifecycle
+
+    async def put_lifecycle(self, bucket: str,
+                            rules: list[dict]) -> None:
+        """Store the bucket's LC rules (RGWPutLC role). Each rule:
+        {"id": str, "prefix": str, "days": float,
+         "noncurrent_days": float} — ``days`` expires CURRENT objects
+        (versioned buckets get a delete marker, unversioned delete),
+        ``noncurrent_days`` expires non-current versions for good.
+        Either may be absent/None. Fractional days are allowed (the
+        reference's lc_debug_interval testing knob)."""
+        await self._require_bucket(bucket)
+        enc = denc.enc_list(rules, lambda r: (
+            denc.enc_str(r.get("id", ""))
+            + denc.enc_str(r.get("prefix", ""))
+            + denc.enc_str(str(r["days"])
+                           if r.get("days") is not None else "")
+            + denc.enc_str(str(r["noncurrent_days"])
+                           if r.get("noncurrent_days") is not None
+                           else "")))
+        await self.client.setxattr(self.pool_id, _index_oid(bucket),
+                                   self.ATTR_LIFECYCLE, enc)
+
+    async def get_lifecycle(self, bucket: str) -> list[dict]:
+        await self._require_bucket(bucket)
+        try:
+            raw = await self.client.getxattr(
+                self.pool_id, _index_oid(bucket), self.ATTR_LIFECYCLE)
+        except (KeyError, IOError):
+            return []
+
+        def one(b, o):
+            rid, o = denc.dec_str(b, o)
+            prefix, o = denc.dec_str(b, o)
+            days, o = denc.dec_str(b, o)
+            ncdays, o = denc.dec_str(b, o)
+            return {"id": rid, "prefix": prefix,
+                    "days": float(days) if days else None,
+                    "noncurrent_days":
+                        float(ncdays) if ncdays else None}, o
+
+        return denc.dec_list(raw, 0, one)[0]
+
+    async def lc_process(self, now: float | None = None) -> dict:
+        """One lifecycle pass over every bucket (the rgw_lc.cc
+        RGWLC::process role, driven by the rgw_lc mgr module's tick):
+        expire current objects past ``days`` and non-current versions
+        past ``noncurrent_days``. Returns per-bucket action counts."""
+        now = time.time() if now is None else now
+        report: dict[str, dict] = {}
+        for bucket in await self.list_buckets():
+            rules = await self.get_lifecycle(bucket)
+            if not rules:
+                continue
+            expired = markers = 0
+            for rule in rules:
+                days = rule.get("days")
+                if days is not None:
+                    cutoff = now - days * 86400
+                    ents, _tr = await self.list_objects(
+                        bucket, prefix=rule.get("prefix", ""),
+                        max_keys=10_000)
+                    for ent in ents:
+                        if ent["mtime"] < cutoff:
+                            await self.delete_object(bucket,
+                                                     ent["key"])
+                            markers += 1
+                nc = rule.get("noncurrent_days")
+                if nc is not None:
+                    cutoff = now - nc * 86400
+                    vers = await self.list_object_versions(
+                        bucket, prefix=rule.get("prefix", ""),
+                        max_keys=10_000)
+                    for ent in vers:
+                        if (not ent["is_latest"]
+                                and ent["version_id"]
+                                and ent["mtime"] < cutoff):
+                            await self.delete_object(
+                                bucket, ent["key"],
+                                version_id=ent["version_id"])
+                            expired += 1
+            report[bucket] = {"expired_current": markers,
+                              "expired_noncurrent": expired}
+        return report
 
     # ---------------------------------------------------------- multipart
 
@@ -324,6 +695,8 @@ class RGWLite:
 
     async def initiate_multipart(self, bucket: str, key: str) -> str:
         await self._require_bucket(bucket)
+        if "\x00" in key:
+            raise RGWError("InvalidObjectName")
         upload_id = hashlib.md5(
             f"{bucket}/{key}/{time.time()}".encode()
         ).hexdigest()[:16]
@@ -356,6 +729,28 @@ class RGWLite:
             total += size
             manifest.append((oid, size))
         etag = f"{hashlib.md5(md5s).hexdigest()}-{len(parts)}"
+        if await self._versioning_enabled(bucket):
+            # versioned complete: assemble into a regular version (one
+            # copy at complete time — the lite trade for per-version
+            # manifests) and reclaim the parts
+            data = b"".join(await asyncio.gather(*(
+                self.client.read(self.pool_id, oid)
+                for oid, _sz in manifest)))
+            now = time.time()
+            vid = _new_vid(now)
+            await self._preserve_null_version(bucket, key)
+            await self.client.write_full(
+                self.pool_id, _ver_oid(bucket, key, vid), data)
+            entry = _enc_entry(total, etag, now, vid=vid)
+            await self.index.put(bucket, _ver_index_key(key, vid),
+                                 entry)
+            await self.index.put(bucket, key, entry)
+            for oid, _sz in manifest:
+                try:
+                    await self.client.delete(self.pool_id, oid)
+                except KeyError:
+                    pass
+            return etag, vid
         enc = denc.enc_list(
             manifest,
             lambda e: denc.enc_str(e[0]) + denc.enc_u64(e[1]),
@@ -544,7 +939,8 @@ class S3Frontend:
                      body: bytes):
         parsed = urllib.parse.urlsplit(target)
         path = urllib.parse.unquote(parsed.path)
-        query = urllib.parse.parse_qs(parsed.query)
+        query = urllib.parse.parse_qs(parsed.query,
+                                      keep_blank_values=True)
         parts = [p for p in path.split("/") if p]
         try:
             if not parts:
@@ -554,6 +950,14 @@ class S3Frontend:
             bucket = parts[0]
             key = "/".join(parts[1:])
             if not key:
+                if "versioning" in query:
+                    return await self._bucket_versioning(
+                        method, bucket, body)
+                if "lifecycle" in query:
+                    return await self._bucket_lifecycle(
+                        method, bucket, body)
+                if "versions" in query:
+                    return await self._list_versions(bucket, query)
                 if method == "PUT":
                     await self.rgw.create_bucket(bucket)
                     return 200, {}, b""
@@ -563,6 +967,7 @@ class S3Frontend:
                 if method == "GET":
                     return await self._list_objects(bucket, query)
                 return 400, {}, b""
+            vid = query.get("versionId", [""])[0]
             if method == "PUT":
                 src = headers.get("x-amz-copy-source")
                 if src:
@@ -571,25 +976,106 @@ class S3Frontend:
                                                       key)
                 else:
                     etag = await self.rgw.put_object(bucket, key, body)
-                return 200, {"etag": f'"{etag}"'}, b""
+                rh = {}
+                if isinstance(etag, tuple):
+                    etag, new_vid = etag
+                    rh["x-amz-version-id"] = new_vid
+                rh["etag"] = f'"{etag}"'
+                return 200, rh, b""
             if method == "GET":
-                data, meta = await self.rgw.get_object(bucket, key)
-                return 200, {"etag": f'"{meta["etag"]}"'}, data
+                data, meta = await self.rgw.get_object(
+                    bucket, key, version_id=vid)
+                rh = {"etag": f'"{meta["etag"]}"'}
+                if meta["version_id"]:
+                    rh["x-amz-version-id"] = meta["version_id"]
+                return 200, rh, data
             if method == "HEAD":
-                meta = await self.rgw.head_object(bucket, key)
+                meta = await self.rgw.head_object(bucket, key,
+                                                  version_id=vid)
                 return 200, {
                     "etag": f'"{meta["etag"]}"',
                     "content-length": str(meta["size"]),
                 }, b""
             if method == "DELETE":
-                await self.rgw.delete_object(bucket, key)
-                return 204, {}, b""
+                marker_vid = await self.rgw.delete_object(
+                    bucket, key, version_id=vid)
+                rh = {}
+                if marker_vid:
+                    rh["x-amz-version-id"] = marker_vid
+                    if not vid:
+                        rh["x-amz-delete-marker"] = "true"
+                return 204, rh, b""
             return 400, {}, b""
         except RGWError as e:
             err = ET.Element("Error")
             ET.SubElement(err, "Code").text = e.code
             return e.status, {"content-type": "application/xml"}, \
                 _xml(err)
+
+    async def _bucket_versioning(self, method: str, bucket: str,
+                                 body: bytes):
+        if method == "PUT":
+            status = "Enabled" if b"Enabled" in body else "Suspended"
+            await self.rgw.put_bucket_versioning(bucket, status)
+            return 200, {}, b""
+        status = await self.rgw.get_bucket_versioning(bucket)
+        root = ET.Element("VersioningConfiguration")
+        if status:
+            ET.SubElement(root, "Status").text = status
+        return 200, {"content-type": "application/xml"}, _xml(root)
+
+    async def _bucket_lifecycle(self, method: str, bucket: str,
+                                body: bytes):
+        if method == "PUT":
+            rules = []
+            for r in ET.fromstring(body).iter("Rule"):
+                days = r.findtext("Expiration/Days")
+                nc = r.findtext(
+                    "NoncurrentVersionExpiration/NoncurrentDays")
+                rules.append({
+                    "id": r.findtext("ID") or "",
+                    "prefix": (r.findtext("Filter/Prefix")
+                               or r.findtext("Prefix") or ""),
+                    "days": float(days) if days else None,
+                    "noncurrent_days": float(nc) if nc else None,
+                })
+            await self.rgw.put_lifecycle(bucket, rules)
+            return 200, {}, b""
+        rules = await self.rgw.get_lifecycle(bucket)
+        root = ET.Element("LifecycleConfiguration")
+        for r in rules:
+            el = ET.SubElement(root, "Rule")
+            ET.SubElement(el, "ID").text = r["id"]
+            ET.SubElement(el, "Prefix").text = r["prefix"]
+            if r["days"] is not None:
+                exp = ET.SubElement(el, "Expiration")
+                ET.SubElement(exp, "Days").text = str(r["days"])
+            if r["noncurrent_days"] is not None:
+                nce = ET.SubElement(el, "NoncurrentVersionExpiration")
+                ET.SubElement(nce, "NoncurrentDays").text = \
+                    str(r["noncurrent_days"])
+        return 200, {"content-type": "application/xml"}, _xml(root)
+
+    async def _list_versions(self, bucket: str, query: dict):
+        vers = await self.rgw.list_object_versions(
+            bucket,
+            prefix=query.get("prefix", [""])[0],
+            max_keys=int(query.get("max-keys", ["1000"])[0]))
+        root = ET.Element("ListVersionsResult")
+        ET.SubElement(root, "Name").text = bucket
+        for e in vers:
+            tag = ("DeleteMarker" if e["delete_marker"]
+                   else "Version")
+            el = ET.SubElement(root, tag)
+            ET.SubElement(el, "Key").text = e["key"]
+            ET.SubElement(el, "VersionId").text = \
+                e["version_id"] or "null"
+            ET.SubElement(el, "IsLatest").text = \
+                "true" if e.get("is_latest") else "false"
+            if not e["delete_marker"]:
+                ET.SubElement(el, "Size").text = str(e["size"])
+                ET.SubElement(el, "ETag").text = f'"{e["etag"]}"'
+        return 200, {"content-type": "application/xml"}, _xml(root)
 
     async def _list_buckets(self):
         root = ET.Element("ListAllMyBucketsResult")

@@ -92,3 +92,82 @@ def test_optracker_timelines(tmp_path):
         await c.stop()
 
     run(t())
+
+
+THIRD_PARTY_MODULE = '''
+"""A third-party mgr module (drop-in file format)."""
+from ceph_tpu.cluster.mgr_module import MgrModule
+
+
+class Module(MgrModule):
+    COMMANDS = [{"cmd": "hello world", "desc": "demo command"}]
+
+    def __init__(self, name, host):
+        super().__init__(name, host)
+        self.notifies = []
+
+    def notify(self, what, ident):
+        self.notifies.append((what, ident))
+
+    async def serve(self):
+        await self.set_store("served", "yes")
+
+    async def handle_command(self, cmd, args):
+        osdmap = self.get("osd_map")
+        return {"greeting": args.get("name", "world"),
+                "osds": osdmap.n_osds,
+                "served": self.get_store("served"),
+                "notified": bool(self.notifies)}
+'''
+
+
+def test_mgr_module_host_drop_in(tmp_path):
+    """A third-party module FILE drops into a directory and runs
+    (ActivePyModules role): its command registers on the admin socket,
+    serve() runs, notify() fires on reports, and set_store/get_store
+    persist through the mon's config DB."""
+    async def t():
+        mod_dir = tmp_path / "modules"
+        mod_dir.mkdir()
+        (mod_dir / "hello.py").write_text(THIRD_PARTY_MODULE)
+
+        c = await make()
+        loaded = c.mgr.load_modules_from(mod_dir)
+        assert loaded == ["hello"]
+        # builtins run as modules too — the substrate, not hardcoded
+        assert {"balancer", "pg_autoscaler", "prometheus"} \
+            <= set(c.mgr.modules)
+        await asyncio.sleep(0.6)  # serve() ran; a report tick arrived
+        await c.mgr.start_admin(str(tmp_path / "mgr.sock"))
+        out = await admin_command(c.mgr.admin.path, "hello world",
+                                  name="ceph")
+        assert out["greeting"] == "ceph"
+        assert out["osds"] == 4
+        assert out["served"] == "yes"  # set_store -> config DB -> back
+        assert out["notified"]  # notify() delivered
+        mods = await admin_command(c.mgr.admin.path, "mgr modules")
+        assert "hello" in mods
+        await c.stop()
+
+    run(t())
+
+
+def test_mgr_module_store_survives_mgr_restart(tmp_path):
+    """Module KV (set_store/get_store) lives in the mon's central
+    config DB, so a fresh mgr instance sees it (MonKVStore role)."""
+    async def t():
+        c = await make()
+        await c.mgr.modules["pg_autoscaler"].set_store("marker", "42")
+        await asyncio.sleep(0.3)
+
+        from ceph_tpu.cluster.mgr import MgrLite
+
+        await c.mgr.stop()
+        mgr2 = MgrLite(c.bus, c.mgr.mon)
+        await mgr2.start()
+        await asyncio.sleep(1.2)  # subscribe -> MConfig push lands
+        assert mgr2.modules["pg_autoscaler"].get_store("marker") == "42"
+        c.mgr = mgr2  # let cluster teardown stop the new instance
+        await c.stop()
+
+    run(t())
