@@ -38,7 +38,11 @@ class RadosStriper:
 
     # ------------------------------------------------------------ write
 
-    async def write(self, name: str, data: bytes, offset: int = 0) -> None:
+    async def write(self, name: str, data: bytes, offset: int = 0,
+                    snapc=None) -> None:
+        """``snapc`` (seq, [snap ids desc]) rides every RADOS write so
+        the OSDs clone lazily when the striped object is covered by a
+        snapshot (CephFS data-pool SnapContext role)."""
         extents = file_to_extents(
             self.layout, offset, len(data), self._fmt(name)
         )
@@ -53,7 +57,8 @@ class RadosStriper:
             # the read-modify-write atomically (EC pools rebuild the
             # full object state primary-side)
             await self.client.write(
-                self.pool_id, ex.oid, ex.offset, bytes(piece)
+                self.pool_id, ex.oid, ex.offset, bytes(piece),
+                snapc=snapc,
             )
 
         await asyncio.gather(*(put(ex) for ex in extents))
@@ -61,14 +66,14 @@ class RadosStriper:
         if new_end > await self.stat(name):
             await self.client.write_full(
                 self.pool_id, self._size_oid(name),
-                new_end.to_bytes(8, "little"),
+                new_end.to_bytes(8, "little"), snapc=snapc,
             )
 
 
     # ------------------------------------------------------------- read
 
     async def read(self, name: str, offset: int = 0,
-                   length: int = -1) -> bytes:
+                   length: int = -1, snapid=None) -> bytes:
         if length < 0:
             size = await self.stat(name)
             length = max(0, size - offset)
@@ -82,7 +87,8 @@ class RadosStriper:
         async def get(ex):
             try:
                 data = await self.client.read(
-                    self.pool_id, ex.oid, offset=ex.offset, length=ex.length
+                    self.pool_id, ex.oid, offset=ex.offset,
+                    length=ex.length, snapid=snapid
                 )
             except KeyError:
                 data = b""  # hole: zero-fill
@@ -103,14 +109,17 @@ class RadosStriper:
         except KeyError:
             return 0
 
-    async def remove(self, name: str) -> None:
+    async def remove(self, name: str, snapc=None) -> None:
+        """``snapc`` preserves snapshot clones through the delete (the
+        head becomes a whiteout; snap reads keep working)."""
         size = await self.stat(name)
         n = get_num_objects(self.layout, size)
         fmt = self._fmt(name)
 
         async def rm(oid):
             try:
-                await self.client.delete(self.pool_id, oid)
+                await self.client.delete(self.pool_id, oid,
+                                         snapc=snapc)
             except KeyError:
                 pass
 

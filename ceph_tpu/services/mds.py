@@ -47,6 +47,21 @@ EXPIRE_KEY = b"expired_upto"
 SEQ_BASE_KEY = b"seq_base"
 JOURNAL_OID = b"mdslog"
 JOURNAL_TRIM_BYTES = 1 << 20
+SNAP_TABLE_OID = b"fsmeta.snaps"  # SnapServer table role
+
+
+def _snap_dir_oid(snapid: int, ino: int) -> bytes:
+    """Snapshot copy of a dirfrag (past-parent dentries role): the
+    subtree's metadata is frozen object-by-object at mksnap time; file
+    DATA stays lazy-COW through the data pool's SnapContext."""
+    return b"fssnap.%x.dir.%x" % (snapid, ino)
+
+
+def _under(p: str, dir_path: str) -> bool:
+    """Is path ``p`` inside directory ``dir_path``?"""
+    dp = "/" + "/".join(x for x in dir_path.split("/") if x)
+    pp = "/" + "/".join(x for x in p.split("/") if x)
+    return dp == "/" or pp == dp or pp.startswith(dp + "/")
 
 
 def _enc_entry(seq: int, verb: str, args: dict[str, bytes]) -> bytes:
@@ -69,13 +84,20 @@ class MDSLite:
     """The metadata daemon (rank 0; ``name`` is its bus address)."""
 
     def __init__(self, bus, client, pool_id: int,
-                 name: str = "mds.0", revoke_timeout: float = 2.0):
+                 name: str = "mds.0", revoke_timeout: float = 2.0,
+                 data_pool: int | None = None):
         self.bus = bus
         self.name = name
-        self.fs = fslib.FSLite(client, pool_id)
+        self.fs = fslib.FSLite(client, pool_id, data_pool=data_pool)
+        self.fs.snapc_cb = self._snapc
         self.client = client
         self.meta_pool = pool_id
+        #: where file DATA lives (snap ids are allocated against it)
+        self.data_pool = pool_id if data_pool is None else data_pool
         self.revoke_timeout = revoke_timeout
+        #: (dir ino, snap name) -> snap id (SnapServer table, loaded
+        #: from SNAP_TABLE_OID at start)
+        self.snaps: dict[tuple[int, str], int] = {}
         #: ino -> {client_name: "r" | "w"} (the Locker cap table)
         self.caps: dict[int, dict[str, str]] = {}
         self._revokes: dict[tuple[int, int], asyncio.Future] = {}
@@ -93,7 +115,27 @@ class MDSLite:
 
     async def start(self) -> None:
         self.bus.register(self.name, self.handle)
+        await self._load_snap_table()
         await self._replay_journal()
+
+    async def _load_snap_table(self) -> None:
+        try:
+            omap = await self.client.omap_get(self.meta_pool,
+                                              SNAP_TABLE_OID)
+        except KeyError:
+            return
+        for k, v in omap.items():
+            ino_hex, _, name = k.decode().partition("/")
+            ino, off = denc.dec_u64(v, 0)
+            sid, _ = denc.dec_u64(v, off)
+            self.snaps[(ino, name)] = sid
+
+    def _snapc(self) -> tuple[int, list[int]]:
+        """The data pool's current write SnapContext: every snap id
+        ever taken, newest first (filters through the pool's removed
+        set OSD-side)."""
+        ids = sorted(self.snaps.values(), reverse=True)
+        return (ids[0] if ids else 0, ids)
 
     async def stop(self) -> None:
         self.bus.unregister(self.name)
@@ -221,6 +263,13 @@ class MDSLite:
             return
         try:
             out = await self._serve(src, msg.verb, msg.args)
+            # every reply carries the data pool's CURRENT SnapContext:
+            # clients cache it for their direct data writes, so a
+            # foreign mksnap propagates on the next metadata round trip
+            # (cap recall at mksnap covers writers that never return)
+            seq, ids = self._snapc()
+            out["__snapc"] = denc.enc_u64(seq) + denc.enc_list(
+                ids, denc.enc_u64)
             reply = M.MClientReply(tid=msg.tid, result=0, out=out)
         except fslib.NoEnt:
             reply = M.MClientReply(tid=msg.tid, result=M.ENOENT, out={})
@@ -251,18 +300,23 @@ class MDSLite:
             return {"names": denc.enc_list(
                 [n.encode() for n in names], denc.enc_bytes)}
         if verb == "open":
-            mode = args["mode"].decode()
-            ent = await self.fs.stat(path)
-            if ent["type"] != fslib.T_FILE:
-                raise fslib.FSError(path)
-            ino = ent["ino"]
-            await self._revoke_conflicting(ino, src, mode)
-            # re-stat AFTER the revoke: the previous holder's flushed
-            # size must seed the opener's cap, not the stale dentry
-            ent = await self.fs.stat(path)
-            self.caps.setdefault(ino, {})[src] = mode
-            self._open_paths[ino] = path
-            return _enc_ent(ent)
+            # under the mutation lock: a cap grant + SnapContext issued
+            # mid-mksnap (whose recall loop awaits releases while
+            # holding the lock) would let the opener write head objects
+            # with a PRE-snap snapc — no clone, corrupt snapshot
+            async with self._lock:
+                mode = args["mode"].decode()
+                ent = await self.fs.stat(path)
+                if ent["type"] != fslib.T_FILE:
+                    raise fslib.FSError(path)
+                ino = ent["ino"]
+                await self._revoke_conflicting(ino, src, mode)
+                # re-stat AFTER the revoke: the previous holder's
+                # flushed size must seed the opener's cap
+                ent = await self.fs.stat(path)
+                self.caps.setdefault(ino, {})[src] = mode
+                self._open_paths[ino] = path
+                return _enc_ent(ent)
         if verb == "close":
             ino = denc.dec_u64(args["ino"], 0)[0]
             size = denc.dec_u64(args.get("size",
@@ -276,9 +330,56 @@ class MDSLite:
             size = denc.dec_u64(args["size"], 0)[0]
             await self._apply_flushed_size(ino, size)
             return {}
+        if verb == "lssnap":
+            ino = await self.fs._walk(self.fs._split(path))
+            names = sorted(n for (i, n) in self.snaps if i == ino)
+            return {"names": denc.enc_list(
+                [n.encode() for n in names], denc.enc_bytes)}
+        if verb in ("snapstat", "snaplist"):
+            return await self._serve_snap_read(verb, args, path)
         # -------- journaled mutations (single-flight via the lock)
         async with self._lock:
             return await self._serve_mutation(src, verb, args, path)
+
+    async def _serve_snap_read(self, verb, args, path):
+        """Resolve ``rel`` inside snapshot ``snap`` of dir ``path``
+        (the /dir/.snap/name/rel addressing, SnapServer + snaprealm
+        resolution role) against the FROZEN dirfrag copies."""
+        snap = args["snap"].decode()
+        rel = args.get("rel", b"").decode()
+        dir_ino = await self.fs._walk(self.fs._split(path))
+        sid = self.snaps.get((dir_ino, snap))
+        if sid is None:
+            raise fslib.NoEnt(f"{path}/.snap/{snap}")
+        ino = dir_ino
+        parts = [p for p in rel.split("/") if p]
+        ent = {"ino": ino, "type": fslib.T_DIR, "size": 0, "mtime": 0}
+        for i, name in enumerate(parts):
+            try:
+                omap = await self.client.omap_get(
+                    self.meta_pool, _snap_dir_oid(sid, ino))
+            except KeyError:
+                raise fslib.NoEnt(rel) from None
+            raw = omap.get(name.encode())
+            if raw is None:
+                raise fslib.NoEnt(name)
+            ent = fslib._dec_inode(raw)
+            if i < len(parts) - 1 and ent["type"] != fslib.T_DIR:
+                raise fslib.NotADir(rel)
+            ino = ent["ino"]
+        if verb == "snaplist":
+            if ent["type"] != fslib.T_DIR:
+                raise fslib.NotADir(rel)
+            try:
+                omap = await self.client.omap_get(
+                    self.meta_pool, _snap_dir_oid(sid, ino))
+            except KeyError:
+                omap = {}
+            return {"names": denc.enc_list(
+                sorted(omap), denc.enc_bytes)}
+        out = _enc_ent(ent)
+        out["snapid"] = denc.enc_u64(sid)
+        return out
 
     async def _serve_mutation(self, src, verb, args, path):
         if verb == "create":
@@ -311,10 +412,103 @@ class MDSLite:
                 if p == path:  # cap flushes must follow the rename
                     self._open_paths[ino] = dst
             return {}
+        if verb == "mksnap":
+            name = args["name"].decode()
+            dir_ino = await self.fs._walk(self.fs._split(path))
+            if (dir_ino, name) in self.snaps:
+                raise fslib.Exists(f"{path}/.snap/{name}")
+            # recall every write cap under the subtree FIRST: buffered
+            # sizes must be in the dentries the snapshot freezes
+            # (the reference recalls caps when a snaprealm changes)
+            for ino, p in list(self._open_paths.items()):
+                if _under(p, path):
+                    await self._revoke_conflicting(ino, "__snap", "w")
+            sid = await self.client.selfmanaged_snap_create(
+                self.data_pool)
+            args = dict(args)
+            args["sid"] = denc.enc_u64(sid)
+            args["root"] = denc.enc_u64(dir_ino)
+            seq = await self._journal(verb, args)
+            await self._apply_mksnap(dir_ino, name, sid)
+            await self._expire(seq)
+            return {"snapid": denc.enc_u64(sid)}
+        if verb == "rmsnap":
+            name = args["name"].decode()
+            dir_ino = await self.fs._walk(self.fs._split(path))
+            sid = self.snaps.get((dir_ino, name))
+            if sid is None:
+                raise fslib.NoEnt(name)
+            args = dict(args)
+            args["sid"] = denc.enc_u64(sid)
+            args["root"] = denc.enc_u64(dir_ino)
+            seq = await self._journal(verb, args)
+            await self._apply_rmsnap(dir_ino, name, sid)
+            await self._expire(seq)
+            return {}
         seq = await self._journal(verb, args)
         out = await self._apply(verb, args)
         await self._expire(seq)
         return out
+
+    async def _apply_mksnap(self, dir_ino: int, name: str,
+                            sid: int) -> None:
+        """Freeze the subtree's dirfrags under snapshot oids (BFS; the
+        copy is idempotent, so journal replay just re-copies), then
+        commit the table row — the snapshot exists once the row does."""
+        todo = [dir_ino]
+        while todo:
+            ino = todo.pop()
+            try:
+                omap = await self.client.omap_get(self.meta_pool,
+                                                  fslib._dir_oid(ino))
+            except KeyError:
+                continue
+            await self.client.write_full(self.meta_pool,
+                                         _snap_dir_oid(sid, ino), b"")
+            if omap:
+                await self.client.omap_set(
+                    self.meta_pool, _snap_dir_oid(sid, ino), omap)
+            for raw in omap.values():
+                ent = fslib._dec_inode(raw)
+                if ent["type"] == fslib.T_DIR:
+                    todo.append(ent["ino"])
+        await self.client.omap_set(
+            self.meta_pool, SNAP_TABLE_OID,
+            # row key carries the dir ino: same-named snapshots of
+            # DIFFERENT directories are distinct rows
+            {f"{dir_ino:x}/{name}".encode():
+             denc.enc_u64(dir_ino) + denc.enc_u64(sid)})
+        self.snaps[(dir_ino, name)] = sid
+
+    async def _apply_rmsnap(self, dir_ino: int, name: str,
+                            sid: int) -> None:
+        # post-order: a dir's frozen frag is deleted only AFTER its
+        # children's — a crash mid-removal leaves the root reachable,
+        # so journal replay re-walks and finishes instead of orphaning
+        # descendant objects behind a deleted root
+        async def scrub(ino: int) -> None:
+            try:
+                omap = await self.client.omap_get(
+                    self.meta_pool, _snap_dir_oid(sid, ino))
+            except KeyError:
+                return
+            for raw in omap.values():
+                ent = fslib._dec_inode(raw)
+                if ent["type"] == fslib.T_DIR:
+                    await scrub(ent["ino"])
+            try:
+                await self.client.delete(self.meta_pool,
+                                         _snap_dir_oid(sid, ino))
+            except KeyError:
+                pass
+
+        await scrub(dir_ino)
+        await self.client.omap_rm(
+            self.meta_pool, SNAP_TABLE_OID,
+            [f"{dir_ino:x}/{name}".encode()])
+        self.snaps.pop((dir_ino, name), None)
+        # hand data reclamation to the RADOS snap trimmer
+        await self.client.selfmanaged_snap_remove(self.data_pool, sid)
 
     # ------------------------------------------------------- op execution
 
@@ -324,6 +518,16 @@ class MDSLite:
             await self.fs.mkdir(path)
             return {}
         if verb == "rmdir":
+            try:
+                ino = await self.fs._walk(self.fs._split(path))
+            except fslib.FSError:
+                ino = None
+            if ino is not None and any(
+                    i == ino for (i, _n) in self.snaps):
+                # a removed dir's snapshots would be unreachable AND
+                # their sid pinned in every future SnapContext forever
+                # (CephFS forbids this for the same reason)
+                raise fslib.NotEmpty(f"{path} has snapshots")
             await self.fs.rmdir(path)
             return {}
         if verb == "unlink":
@@ -338,6 +542,16 @@ class MDSLite:
             return {"ino": denc.enc_u64(ino)}
         if verb == "rename":
             await self._apply_rename(path, args["dst"].decode())
+            return {}
+        if verb == "mksnap":
+            sid = denc.dec_u64(args["sid"], 0)[0]
+            root = denc.dec_u64(args["root"], 0)[0]
+            await self._apply_mksnap(root, args["name"].decode(), sid)
+            return {}
+        if verb == "rmsnap":
+            sid = denc.dec_u64(args["sid"], 0)[0]
+            root = denc.dec_u64(args["root"], 0)[0]
+            await self._apply_rmsnap(root, args["name"].decode(), sid)
             return {}
         raise fslib.FSError(f"verb {verb!r}")
 
@@ -406,6 +620,9 @@ class FSClient:
         #: ino -> buffered size under a held write cap
         self.wcaps: dict[int, int] = {}
         self._paths: dict[str, int] = {}
+        #: cached data-pool SnapContext (refreshed from every MDS
+        #: reply); direct data writes carry it so snapshots COW
+        self._snapc: tuple[int, list[int]] = (0, [])
 
     async def connect(self) -> None:
         self.bus.register(self.name, self._handle)
@@ -450,6 +667,11 @@ class FSClient:
             if reply.result == -39:
                 raise fslib.NotEmpty(args.get("path", ""))
             raise fslib.FSError(f"{verb} failed: {reply.result}")
+        snapc_raw = reply.out.pop("__snapc", None)
+        if snapc_raw is not None:
+            seq, off = denc.dec_u64(snapc_raw, 0)
+            ids, _ = denc.dec_list(snapc_raw, off, denc.dec_u64)
+            self._snapc = (seq, ids)
         return reply.out
 
     async def _flush(self, ino: int) -> None:
@@ -513,18 +735,24 @@ class FSClient:
                 ino = await self.open(path, "w")
             except fslib.NoEnt:
                 ino = await self.create(path)
-        await self.striper.write(fslib._data_name(ino), data, offset)
+        await self.striper.write(fslib._data_name(ino), data, offset,
+                                 snapc=self._snapc)
         self.wcaps[ino] = max(self.wcaps.get(ino, 0),
                               offset + len(data))
+
+    @staticmethod
+    def _clamp(ent: dict, what: str, offset: int,
+               length: int) -> int:
+        if ent["type"] != fslib.T_FILE:
+            raise fslib.FSError(f"{what} is a directory")
+        if length < 0:
+            length = max(0, ent["size"] - offset)
+        return min(length, max(0, ent["size"] - offset))
 
     async def read(self, path: str, offset: int = 0,
                    length: int = -1) -> bytes:
         ent = await self.stat(path)
-        if ent["type"] != fslib.T_FILE:
-            raise fslib.FSError(f"{path} is a directory")
-        if length < 0:
-            length = max(0, ent["size"] - offset)
-        length = min(length, max(0, ent["size"] - offset))
+        length = self._clamp(ent, path, offset, length)
         return await self.striper.read(fslib._data_name(ent["ino"]),
                                        offset, length)
 
@@ -533,3 +761,46 @@ class FSClient:
         if ino is not None and ino in self.wcaps:
             self.wcaps[ino] = size
         await self._req("truncate", path=path, size=size)
+
+    # ---------------------------------------------------------- snapshots
+    #
+    # The .snap addressing (SnapServer + snaprealm roles): mksnap
+    # freezes a directory subtree's metadata and pins its files' data
+    # via a RADOS selfmanaged snap; reads address
+    # <dir>/.snap/<name>/<rel>.
+
+    async def mksnap(self, dirpath: str, name: str) -> int:
+        out = await self._req("mksnap", path=dirpath, name=name)
+        return denc.dec_u64(out["snapid"], 0)[0]
+
+    async def rmsnap(self, dirpath: str, name: str) -> None:
+        await self._req("rmsnap", path=dirpath, name=name)
+
+    async def lssnap(self, dirpath: str) -> list[str]:
+        out = await self._req("lssnap", path=dirpath)
+        names, _ = denc.dec_list(out["names"], 0, denc.dec_bytes)
+        return [n.decode() for n in names]
+
+    async def snap_stat(self, dirpath: str, snap: str,
+                        rel: str) -> dict:
+        out = await self._req("snapstat", path=dirpath, snap=snap,
+                              rel=rel)
+        return {"ino": denc.dec_u64(out["ino"], 0)[0],
+                "type": denc.dec_u8(out["type"], 0)[0],
+                "size": denc.dec_u64(out["size"], 0)[0],
+                "snapid": denc.dec_u64(out["snapid"], 0)[0]}
+
+    async def snap_listdir(self, dirpath: str, snap: str,
+                           rel: str = "") -> list[str]:
+        out = await self._req("snaplist", path=dirpath, snap=snap,
+                              rel=rel)
+        names, _ = denc.dec_list(out["names"], 0, denc.dec_bytes)
+        return [n.decode() for n in names]
+
+    async def snap_read(self, dirpath: str, snap: str, rel: str,
+                        offset: int = 0, length: int = -1) -> bytes:
+        ent = await self.snap_stat(dirpath, snap, rel)
+        length = self._clamp(ent, rel, offset, length)
+        return await self.striper.read(
+            fslib._data_name(ent["ino"]), offset, length,
+            snapid=ent["snapid"])
