@@ -607,14 +607,24 @@ class FSClient:
 
     def __init__(self, bus, client, data_pool: int,
                  name: str = "fsclient.0", mds: str = "mds.0",
-                 timeout: float = 10.0):
+                 timeout: float = 10.0, cache: bool = False):
         from ..osdc.striped_client import RadosStriper
 
         self.bus = bus
         self.name = name
         self.mds = mds
         self.timeout = timeout
-        self.striper = RadosStriper(client, data_pool)
+        #: optional write-back/read-ahead data cache (ObjectCacher
+        #: role, cap-fenced: flushed+invalidated on revoke/close). The
+        #: striper sees the cache as its client for data objects.
+        self._cacher = None
+        data_io = client
+        if cache:
+            from ..osdc.object_cacher import CacheIo, ObjectCacher
+
+            self._cacher = ObjectCacher(client, data_pool)
+            data_io = CacheIo(client, self._cacher)
+        self.striper = RadosStriper(data_io, data_pool)
         self._tid = 0
         self._futs: dict[int, asyncio.Future] = {}
         #: ino -> buffered size under a held write cap
@@ -628,6 +638,8 @@ class FSClient:
         self.bus.register(self.name, self._handle)
 
     async def close(self) -> None:
+        if self._cacher is not None:
+            await self._cacher.flush()
         for ino in list(self.wcaps):
             await self._flush(ino)
         self.bus.unregister(self.name)
@@ -638,6 +650,12 @@ class FSClient:
             if fut is not None and not fut.done():
                 fut.set_result(msg)
         elif isinstance(msg, M.MCapRevoke):
+            if self._cacher is not None:
+                # the cap fence: buffered data lands before the cap
+                # (and with it our write authority) is handed back,
+                # then nothing cached may be trusted
+                await self._cacher.flush()
+                self._cacher.invalidate()
             size = self.wcaps.pop(msg.ino, NOSIZE)
             await self.bus.send(
                 self.name, src,
@@ -675,6 +693,9 @@ class FSClient:
         return reply.out
 
     async def _flush(self, ino: int) -> None:
+        if self._cacher is not None:
+            # data lands before the size that describes it
+            await self._cacher.flush()
         size = self.wcaps.pop(ino, NOSIZE)
         if size != NOSIZE:
             await self._req("setsize", ino=ino, size=size)
@@ -751,6 +772,15 @@ class FSClient:
 
     async def read(self, path: str, offset: int = 0,
                    length: int = -1) -> bytes:
+        if self._cacher is not None and path not in self._paths:
+            # register an "r" cap (Locker role): a later writer's open
+            # revokes it, which is what flushes+invalidates our cache —
+            # without the cap, cached clean bytes would go stale the
+            # moment another client writes
+            try:
+                await self.open(path, "r")
+            except fslib.FSError:
+                pass  # directories etc.: stat below raises properly
         ent = await self.stat(path)
         length = self._clamp(ent, path, offset, length)
         return await self.striper.read(fslib._data_name(ent["ino"]),

@@ -151,8 +151,10 @@ class RBD:
                 .setxattr(ATTR_OMAP_BITS, bytes(nobj)))
         await self.client.operate(self.pool_id, _omap_oid(name), seed)
 
-    async def open(self, name: str, snap: str | None = None) -> "Image":
-        img = Image(self.client, self.pool_id, name, snap=snap)
+    async def open(self, name: str, snap: str | None = None,
+                   cache: bool = False) -> "Image":
+        img = Image(self.client, self.pool_id, name, snap=snap,
+                    cache=cache)
         await img.refresh()
         return img
 
@@ -207,10 +209,24 @@ class Image:
     """One open image (librbd::Image role)."""
 
     def __init__(self, client, pool_id: int, name: str,
-                 snap: str | None = None, exclusive: bool = True):
+                 snap: str | None = None, exclusive: bool = True,
+                 cache: bool = False):
         self.client = client
         self.pool_id = pool_id
         self.name = name
+        #: optional write-back/read-ahead data cache (ObjectCacher
+        #: role); only served while the exclusive lock is OWNED (cached
+        #: reads acquire it, librbd's exclusive-lock+cache behavior),
+        #: flushed + invalidated at every ownership/snapshot boundary.
+        #: _io is the data-path client: the CacheIo facade when caching,
+        #: the raw client otherwise — call sites never branch.
+        self._cacher = None
+        self._io = client
+        if cache and snap is None:
+            from ..osdc.object_cacher import CacheIo, ObjectCacher
+
+            self._cacher = ObjectCacher(client, pool_id)
+            self._io = CacheIo(client, self._cacher)
         self.snap = snap
         self.size = 0
         self.layout = DEFAULT_LAYOUT
@@ -348,6 +364,11 @@ class Image:
             while self._lock_users:
                 self._idle_ev.clear()
                 await self._idle_ev.wait()
+            if self._cacher is not None:
+                # the cache fence: buffered writes land before the
+                # lock can change hands, then nothing stale survives
+                await self._cacher.flush()
+                self._cacher.invalidate()
             await self._save_object_map()
             self.lock_owned = False
             self._omap = None
@@ -496,6 +517,12 @@ class Image:
             self._omap[objectno] = want
             self._omap_dirty = True
 
+    async def flush(self) -> None:
+        """Force buffered cache writes out (librbd flush role); no-op
+        without the cache."""
+        if self._cacher is not None:
+            await self._cacher.flush()
+
     def object_map(self) -> bytes | None:
         """Fast-diff surface: per-object state bytes (0 absent,
         1 exists, 2 pending); None when not authoritative (lock not
@@ -616,9 +643,9 @@ class Image:
                     piece[pos : pos + ln] = data[bo : bo + ln]
                     pos += ln
                 await self._copy_up(ex.objectno)
-                await self.client.write(self.pool_id, ex.oid, ex.offset,
-                                        bytes(piece),
-                                        snapc=self._snapc())
+                await self._io.write(self.pool_id, ex.oid, ex.offset,
+                                     bytes(piece),
+                                     snapc=self._snapc())
                 self._omap_settle(ex.objectno, True)
 
             await asyncio.gather(*(put(ex) for ex in extents))
@@ -648,7 +675,7 @@ class Image:
         except KeyError:
             return  # parent hole: child object starts empty
         await self._omap_prewrite((objectno,))
-        await self.client.write_full(
+        await self._io.write_full(
             self.pool_id, self._oid(objectno), blob,
             snapc=self._snapc(),
         )
@@ -671,8 +698,13 @@ class Image:
 
     async def _read_object(self, ex) -> bytes:
         snapid = self.snap_ids.get(self.snap) if self.snap else None
+        if self._cacher is not None and snapid is None:
+            # cached reads are only coherent while WE own the lock (a
+            # peer's writes flush at ITS release, but our cached clean
+            # bytes would never invalidate): acquire before serving
+            await self._ensure_lock()
         try:
-            return await self.client.read(
+            return await self._io.read(
                 self.pool_id, ex.oid, offset=ex.offset,
                 length=ex.length, snapid=snapid,
             )
@@ -705,7 +737,7 @@ class Image:
             for ex in extents:
                 await self._copy_up(ex.objectno)
                 try:
-                    await self.client.zero(
+                    await self._io.zero(
                         self.pool_id, ex.oid, ex.offset, ex.length,
                         snapc=self._snapc())
                 except KeyError:
@@ -719,8 +751,8 @@ class Image:
 
     async def _rm_object(self, objno: int):
         try:
-            await self.client.delete(self.pool_id, self._oid(objno),
-                                     snapc=self._snapc())
+            await self._io.delete(self.pool_id, self._oid(objno),
+                                  snapc=self._snapc())
         except KeyError:
             pass
         self._omap_settle(objno, False)
@@ -750,6 +782,10 @@ class Image:
         self._writable()
         await self._ensure_lock()
         async with self._io_guard():
+            if self._cacher is not None:
+                # snapshot boundary: buffered writes must be part of
+                # the snapshot (librbd flushes its cache here too)
+                await self._cacher.flush()
             await self.refresh()
             if snap in self.snaps:
                 raise ImageExists(f"{self.name}@{snap}")

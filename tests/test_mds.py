@@ -306,3 +306,50 @@ def test_snapshots_survive_mds_restart():
         await c.stop()
 
     run(t())
+
+
+def test_object_cacher_fs_cap_fence():
+    """ObjectCacher under the fs client: buffered data flushes when the
+    MDS revokes the write cap, so the OTHER client reads it all."""
+    async def t():
+        c, mds, _a, _b = await make()
+        a = FSClient(c.bus, c.client, 1, name="fsclient.ca",
+                     cache=True)
+        b = FSClient(c.bus, c.client, 1, name="fsclient.cb")
+        await a.connect()
+        await b.connect()
+        await a.write("/doc", b"cached-" * 1000)
+        assert a._cacher.dirty_bytes() > 0  # write-back, not landed
+        # b's stat triggers the cap revoke -> a flushes data THEN size
+        assert await b.read("/doc") == b"cached-" * 1000
+        assert a._cacher.dirty_bytes() == 0
+        await a.close()
+        await b.close()
+        await c.stop()
+
+    run(t())
+
+
+def test_cached_reader_invalidated_by_foreign_write():
+    """Reader-side coherence: a cached fs reader registers an r cap, so
+    a foreign writer's open revokes it and the cache drops — the next
+    read sees the new content (no stale serve)."""
+    async def t():
+        c, mds, _a, _b = await make()
+        rdr = FSClient(c.bus, c.client, 1, name="fsclient.r",
+                       cache=True)
+        wtr = FSClient(c.bus, c.client, 1, name="fsclient.w")
+        await rdr.connect()
+        await wtr.connect()
+        await wtr.write("/news", b"first edition")
+        await wtr._flush(wtr._paths["/news"])
+        assert await rdr.read("/news") == b"first edition"  # cached now
+        await wtr.write("/news", b"SECOND edition")
+        await wtr._flush(wtr._paths["/news"])
+        # the writer's open revoked rdr's r cap -> cache invalidated
+        assert await rdr.read("/news") == b"SECOND edition"
+        await rdr.close()
+        await wtr.close()
+        await c.stop()
+
+    run(t())

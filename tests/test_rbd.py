@@ -264,3 +264,41 @@ def test_object_map_fast_diff_and_flatten():
         await c.stop()
 
     run(t())
+
+
+def test_object_cacher_rbd_write_back_and_fence():
+    """ObjectCacher under rbd (ObjectCacher.h role): reads serve from
+    cache after one fetch, writes buffer (write-back — nothing lands
+    until a flush boundary), and the exclusive-lock release fence
+    flushes so the next owner sees everything."""
+    async def t():
+        c, rbd = await make()
+        await rbd.create("disk", 8 * 8192, LAYOUT)
+        img = await rbd.open("disk", cache=True)
+        await img.write(0, b"A" * 8192)
+        # write-back: buffered, not yet on the OSDs
+        assert img._cacher.dirty_bytes() == 8192
+        assert await img.read(0, 8192) == b"A" * 8192  # served hot
+        hits0 = img._cacher.hits
+        await img.read(0, 100)
+        await img.read(4000, 100)
+        assert img._cacher.hits >= hits0 + 2  # no server round trips
+
+        # the lock-release fence flushes; an UNCACHED second handle
+        # (fresh client view) reads everything back
+        await img.release_lock()
+        assert img._cacher.dirty_bytes() == 0
+        img2 = await rbd.open("disk")
+        assert await img2.read(0, 8192) == b"A" * 8192
+
+        # snapshot boundary flushes buffered writes into the snap
+        await img.write(8192, b"B" * 8192)
+        await img.snap_create("s")
+        await img.write(8192, b"C" * 8192)
+        await img.flush()
+        snap_view = await rbd.open("disk", snap="s")
+        assert await snap_view.read(8192, 8192) == b"B" * 8192
+        assert await img.read(8192, 8192) == b"C" * 8192
+        await c.stop()
+
+    run(t())
