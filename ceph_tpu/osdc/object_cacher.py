@@ -85,6 +85,10 @@ class ObjectCacher:
         self._objs: "OrderedDict[bytes, _CachedObject]" = OrderedDict()
         self.hits = 0
         self.misses = 0
+        # running totals — write/evict paths consult these on every op,
+        # so they must be O(1), not a sweep of the table
+        self._cached = 0
+        self._dirty = 0
 
     # ------------------------------------------------------------- state
 
@@ -100,10 +104,16 @@ class ObjectCacher:
         return obj
 
     def cached_bytes(self) -> int:
-        return sum(len(o.data) for o in self._objs.values())
+        return self._cached
 
     def dirty_bytes(self) -> int:
-        return sum(o.dirty_bytes() for o in self._objs.values())
+        return self._dirty
+
+    def _drop(self, oid: bytes) -> None:
+        obj = self._objs.pop(oid, None)
+        if obj is not None:
+            self._cached -= len(obj.data)
+            self._dirty -= obj.dirty_bytes()
 
     # -------------------------------------------------------------- read
 
@@ -146,6 +156,7 @@ class ObjectCacher:
             base.extend(bytes(len(obj.data) - len(base)))
         for o, e in obj.dirty:
             base[o:e] = obj.data[o:e]
+        self._cached += len(base) - len(obj.data)
         obj.data = base
         obj.fetched = True
         await self._evict_clean()
@@ -159,9 +170,12 @@ class ObjectCacher:
         obj.absent = False
         end = offset + len(data)
         if len(obj.data) < end:
+            self._cached += end - len(obj.data)
             obj.data.extend(bytes(end - len(obj.data)))
         obj.data[offset:end] = data
+        before = obj.dirty_bytes()
         obj.add_dirty(offset, end)
+        self._dirty += obj.dirty_bytes() - before
         obj.snapc = snapc
         if self.dirty_bytes() > self.max_dirty:
             await self._flush_down_to(self.target_dirty)
@@ -171,6 +185,8 @@ class ObjectCacher:
         oid = self._norm(name)
         obj = self._touch(oid)
         obj.absent = False
+        self._cached += len(data) - len(obj.data)
+        self._dirty += len(data) - obj.dirty_bytes()
         obj.data = bytearray(data)
         obj.fetched = False
         obj.full_rewrite = True
@@ -201,6 +217,7 @@ class ObjectCacher:
         # lost past a fence. The byte payloads snapshot with the ranges
         # for the same reason.
         pending, obj.dirty = obj.dirty, []
+        self._dirty -= sum(e - o for o, e in pending)
         full, obj.full_rewrite = obj.full_rewrite, False
         snapc = obj.snapc
         payload = (bytes(obj.data) if full
@@ -218,8 +235,10 @@ class ObjectCacher:
         except BaseException:
             # failed flush: the data is still dirty — re-merge so a
             # later flush retries it
+            before = obj.dirty_bytes()
             for o, e in pending:
                 obj.add_dirty(o, e)
+            self._dirty += obj.dirty_bytes() - before
             obj.full_rewrite = obj.full_rewrite or full
             raise
 
@@ -233,7 +252,7 @@ class ObjectCacher:
         while self.cached_bytes() > self.max_bytes:
             for oid, obj in list(self._objs.items()):
                 if not obj.dirty:
-                    del self._objs[oid]
+                    self._drop(oid)
                     break
             else:  # everything dirty: flush, then retry eviction
                 await self._flush_down_to(0)
@@ -245,8 +264,10 @@ class ObjectCacher:
         discarding is the point, e.g. after losing the lock)."""
         if name is None:
             self._objs.clear()
+            self._cached = 0
+            self._dirty = 0
         else:
-            self._objs.pop(self._norm(name), None)
+            self._drop(self._norm(name))
 
 
 class CacheIo:

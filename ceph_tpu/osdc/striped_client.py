@@ -97,6 +97,26 @@ class RadosStriper:
         await asyncio.gather(*(get(ex) for ex in extents))
         return result.assemble()
 
+    async def pread(self, name: str, offset: int,
+                    length: int) -> tuple[bytes, int]:
+        """Bounded read + logical size in ONE concurrent fan-out (the
+        extent gets and the size-header get ride the same gather, so
+        callers that need EOF semantics — e.g. the sqlite VFS short
+        read — pay one round-trip latency, not two)."""
+        size_task = asyncio.ensure_future(self.stat(name))
+        try:
+            data = await self.read(name, offset, max(0, length))
+        except BaseException:
+            size_task.cancel()
+            try:  # retrieve its result: no orphaned-exception warning
+                await size_task
+            except BaseException:
+                pass
+            raise
+        size = await size_task
+        avail = max(0, min(length, size - offset))
+        return data[:avail], size
+
     # ------------------------------------------------------------- meta
 
     async def stat(self, name: str) -> int:
@@ -108,6 +128,55 @@ class RadosStriper:
             return int.from_bytes(raw[:8], "little")
         except KeyError:
             return 0
+
+    async def truncate(self, name: str, size: int, snapc=None) -> None:
+        """Cut the logical file at ``size``: covering objects shrink to
+        the last stripe-extent the new size still reaches, objects past
+        it are removed (RadosStriperImpl::truncate role)."""
+        old = await self.stat(name)
+        if size >= old:
+            if size > old:
+                await self.client.write_full(
+                    self.pool_id, self._size_oid(name),
+                    size.to_bytes(8, "little"), snapc=snapc)
+            return
+        fmt = self._fmt(name)
+        # only objects overlapping the CUT range [size, old) need an
+        # op (touching the kept range would also materialize hole
+        # objects, since the OSD truncate op creates-if-missing)
+        affected = {ex.oid
+                    for ex in file_to_extents(self.layout, size,
+                                              old - size, fmt)}
+        keep: dict[bytes, int] = {}
+        if size > 0:
+            for ex in file_to_extents(self.layout, 0, size, fmt):
+                keep[ex.oid] = max(keep.get(ex.oid, 0),
+                                   ex.offset + ex.length)
+
+        async def cut(oid: bytes):
+            if oid in keep:  # boundary object: shrink to its kept tail
+                await self.client.truncate(self.pool_id, oid,
+                                           keep[oid], snapc=snapc)
+            else:
+                try:
+                    await self.client.delete(self.pool_id, oid,
+                                             snapc=snapc)
+                except KeyError:
+                    pass
+
+        await asyncio.gather(*(cut(oid) for oid in affected))
+        await self.client.write_full(
+            self.pool_id, self._size_oid(name),
+            size.to_bytes(8, "little"), snapc=snapc)
+
+    async def exists(self, name: str) -> bool:
+        """True once the striped file has ever been written (its size
+        header object exists)."""
+        try:
+            await self.client.stat(self.pool_id, self._size_oid(name))
+            return True
+        except KeyError:
+            return False
 
     async def remove(self, name: str, snapc=None) -> None:
         """``snapc`` preserves snapshot clones through the delete (the

@@ -259,8 +259,6 @@ class FSLite:
         ent = await self._dentry(parent, name)
         if ent["type"] != T_FILE:
             raise FSError(f"{path} is a directory")
-        # logical-size truncate (grow zero-fills on read; shrink hides
-        # the tail — the striper's size header is authoritative)
         await self.client.omap_set(
             self.pool_id, _dir_oid(parent),
             {name.encode(): _enc_inode(ent["ino"], T_FILE, size,
@@ -269,6 +267,12 @@ class FSLite:
         if size == 0:
             await self.striper.remove(_data_name(ent["ino"]),
                                       snapc=self._snapc())
+        elif size < ent["size"]:
+            # physically cut the data tail: a later re-extending write
+            # must read zeros in the gap, not the pre-truncate bytes
+            # (grow stays logical: holes already read zero)
+            await self.striper.truncate(_data_name(ent["ino"]), size,
+                                        snapc=self._snapc())
 
     async def unlink(self, path: str) -> None:
         parent, name = await self._resolve(path)

@@ -445,6 +445,14 @@ class MDSLite:
             await self._apply_rmsnap(dir_ino, name, sid)
             await self._expire(seq)
             return {}
+        if verb == "truncate":
+            ent = await self.fs.stat(path)
+            if ent["type"] == fslib.T_FILE:
+                # truncate is a write: recall EVERY other cap FIRST so
+                # cached readers drop the doomed bytes and buffered
+                # writers flush before (not after) the cut. Recalled
+                # here, not in _apply — replay has no clients to call.
+                await self._revoke_conflicting(ent["ino"], src, "w")
         seq = await self._journal(verb, args)
         out = await self._apply(verb, args)
         await self._expire(seq)
@@ -788,8 +796,19 @@ class FSClient:
 
     async def truncate(self, path: str, size: int) -> None:
         ino = self._paths.get(path)
-        if ino is not None and ino in self.wcaps:
-            self.wcaps[ino] = size
+        if ino is not None:
+            # full fence FIRST: buffered data and the authoritative
+            # size reach the MDS before it decides grow-vs-shrink and
+            # cuts the data objects (flushing after would resurrect
+            # truncated-away bytes)
+            await self._flush(ino)
+        if self._cacher is not None:
+            # flush even when the file was never opened here — the
+            # wholesale invalidate below must not discard OTHER files'
+            # buffered dirty writes
+            await self._cacher.flush()
+            # drop cached content: nothing past the cut may be served
+            self._cacher.invalidate()
         await self._req("truncate", path=path, size=size)
 
     # ---------------------------------------------------------- snapshots

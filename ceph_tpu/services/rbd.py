@@ -586,6 +586,13 @@ class Image:
 
     async def _resize_locked(self, new_size: int) -> None:
         old = self.size
+        if new_size < old and self._cacher is not None:
+            # shrink mutates objects server-side behind the cache:
+            # land buffered writes first (they precede the resize),
+            # then drop cached content so nothing past the cut is
+            # served or re-flushed later (librbd invalidates too)
+            await self._cacher.flush()
+            self._cacher.invalidate()
         if new_size < old:
             # drop whole objects past the end, truncate the boundary one
             lo = self.layout
@@ -814,6 +821,13 @@ class Image:
             await self._rollback_locked(snap)
 
     async def _rollback_locked(self, snap: str) -> None:
+        if self._cacher is not None:
+            # rollback rewrites objects server-side via the RAW client:
+            # flush pre-rollback buffered writes (they happened before
+            # the rollback), then invalidate so no pre-rollback bytes
+            # are served from cache afterwards
+            await self._cacher.flush()
+            self._cacher.invalidate()
         await self.refresh()
         if snap not in self.snaps:
             raise KeyError(snap)

@@ -37,16 +37,23 @@ import numpy as np
 
 from ..checksum import Checksummer
 from ..native import rt
+from ..utils import compress as comp_mod
 from ..utils import denc
 from . import transaction as tx
 from .base import NotFound, ObjectStore, StoreError
 
 BLOCK = 4096
 HOLE = 0xFFFFFFFF  # block-map entry for an unallocated (all-zero) block
+CBLOB = 0xFFFFFFFE  # block-map entry: block lives inside a compressed blob
 SEP = b"\x00\x00"
 #: writes at or below this total length defer partial-block updates
 #: through the kv WAL instead of COW (bluestore_prefer_deferred_size)
 DEFER_MAX_BYTES = 64 * 1024
+#: inline blob compression bounds (bluestore_compression_min/max_blob_size
+#: roles): only aligned spans of >= MIN full blocks are candidates, cut
+#: into blobs of <= MAX blocks each
+COMPRESS_MIN_BLOCKS = 4    # 16 KiB
+COMPRESS_MAX_BLOCKS = 16   # 64 KiB
 
 K_COLL = b"C"
 K_ONODE = b"O"
@@ -67,11 +74,36 @@ def _okey(cid: str, oid: bytes) -> bytes:
     return _esc(cid.encode()) + SEP + _esc(oid)
 
 
+class CBlob:
+    """One compressed blob (bluestore_blob_t FLAG_COMPRESSED role): a
+    run of ``nblocks`` logical blocks stored as ``len(phys)`` physical
+    blocks of compressed bytes (``clen`` real bytes, zero-padded to the
+    block grid). ``csums`` are per PHYSICAL block, over the compressed
+    bytes — verified before decompression, like the reference checksums
+    compressed extents."""
+
+    __slots__ = ("nblocks", "phys", "clen", "alg", "csums")
+
+    def __init__(self, nblocks: int, phys: list[int], clen: int,
+                 alg: str, csums: list[int]):
+        self.nblocks = nblocks
+        self.phys = phys
+        self.clen = clen
+        self.alg = alg
+        self.csums = csums
+
+    def copy(self) -> "CBlob":
+        return CBlob(self.nblocks, list(self.phys), self.clen,
+                     self.alg, list(self.csums))
+
+
 class Onode:
     """Per-object metadata: size, 4K block map, per-block crc32c,
-    xattrs, omap (omap is authoritative in kv; cached here)."""
+    compressed blobs, xattrs, omap (omap is authoritative in kv;
+    cached here)."""
 
-    __slots__ = ("size", "blocks", "csums", "xattrs", "omap", "omap_header")
+    __slots__ = ("size", "blocks", "csums", "xattrs", "omap",
+                 "omap_header", "cblobs")
 
     def __init__(self):
         self.size = 0
@@ -80,6 +112,7 @@ class Onode:
         self.xattrs: dict[str, bytes] = {}
         self.omap: dict[bytes, bytes] = {}
         self.omap_header = b""
+        self.cblobs: dict[int, CBlob] = {}  # start block index -> blob
 
     def clone_meta(self) -> "Onode":
         o = Onode()
@@ -89,15 +122,32 @@ class Onode:
         o.xattrs = dict(self.xattrs)
         o.omap = dict(self.omap)
         o.omap_header = self.omap_header
+        o.cblobs = {s: cb.copy() for s, cb in self.cblobs.items()}
         return o
 
+    def find_cblob(self, bi: int) -> tuple[int, CBlob] | None:
+        for start, cb in self.cblobs.items():
+            if start <= bi < start + cb.nblocks:
+                return start, cb
+        return None
+
     def encode(self) -> bytes:
-        return b"".join([
+        parts = [
             denc.enc_u64(self.size),
             denc.enc_list(self.blocks, denc.enc_u32),
             denc.enc_list(self.csums, denc.enc_u32),
             denc.enc_map(self.xattrs, denc.enc_str, denc.enc_bytes),
-        ])
+            denc.enc_u32(len(self.cblobs)),
+        ]
+        for start in sorted(self.cblobs):
+            cb = self.cblobs[start]
+            parts += [
+                denc.enc_u32(start), denc.enc_u32(cb.nblocks),
+                denc.enc_list(cb.phys, denc.enc_u32),
+                denc.enc_u32(cb.clen), denc.enc_str(cb.alg),
+                denc.enc_list(cb.csums, denc.enc_u32),
+            ]
+        return b"".join(parts)
 
     @classmethod
     def decode(cls, buf: bytes) -> "Onode":
@@ -106,6 +156,16 @@ class Onode:
         o.blocks, off = denc.dec_list(buf, off, denc.dec_u32)
         o.csums, off = denc.dec_list(buf, off, denc.dec_u32)
         o.xattrs, off = denc.dec_map(buf, off, denc.dec_str, denc.dec_bytes)
+        if off < len(buf):  # v1 records (pre-compression) simply end here
+            n, off = denc.dec_u32(buf, off)
+            for _ in range(n):
+                start, off = denc.dec_u32(buf, off)
+                nblocks, off = denc.dec_u32(buf, off)
+                phys, off = denc.dec_list(buf, off, denc.dec_u32)
+                clen, off = denc.dec_u32(buf, off)
+                alg, off = denc.dec_str(buf, off)
+                csums, off = denc.dec_list(buf, off, denc.dec_u32)
+                o.cblobs[start] = CBlob(nblocks, phys, clen, alg, csums)
         return o
 
 
@@ -176,6 +236,8 @@ class _Txc:
         # (An identity check against the committed dict is not enough:
         # split/merge move committed Onode objects between collections.)
         self.private: set[int] = set()
+        # decompressed-blob cache for this txc: (id(onode), start) -> raw
+        self._blob_raw_cache: dict[tuple[int, int], bytes] = {}
 
     # ------------------------------------------------------------ helpers
 
@@ -216,15 +278,131 @@ class _Txc:
 
     def block_bytes(self, onode: Onode, bi: int) -> bytes:
         """Current contents of logical block bi (staged, deferred,
-        device, hole)."""
+        device, hole, or inside a compressed blob)."""
         if bi >= len(onode.blocks) or onode.blocks[bi] == HOLE:
             return _ZERO_BLOCK
         phys = onode.blocks[bi]
+        if phys == CBLOB:
+            hit = onode.find_cblob(bi)
+            assert hit is not None, f"dangling CBLOB entry at block {bi}"
+            start, cb = hit
+            raw = self.blob_raw(onode, start, cb)
+            return raw[(bi - start) * BLOCK:(bi - start + 1) * BLOCK]
         if phys in self.staged:
             return self.staged[phys]
         if phys in self.deferred:
             return self.deferred[phys]
         return self.store.dev.pread(phys * BLOCK, BLOCK)
+
+    def _free_phys(self, p: int) -> None:
+        """Free one physical block: staged-by-this-txc blocks roll back
+        immediately; committed blocks release after the kv commit."""
+        if p in self.staged:
+            del self.staged[p]
+            self.new_blocks.remove(p)
+            self.store.alloc.release(p, 1)
+        else:
+            self.freed.append(p)
+
+    def free_onode_blocks(self, o: Onode) -> None:
+        for b in o.blocks:
+            if b not in (HOLE, CBLOB):
+                self._free_phys(b)
+        for start, cb in o.cblobs.items():
+            for p in cb.phys:
+                self._free_phys(p)
+            # the onode may be garbage after this; a recycled id()
+            # must not resurrect its decompressed bytes
+            self._blob_raw_cache.pop((id(o), start), None)
+
+    def blob_raw(self, onode: Onode, start: int, cb: CBlob) -> bytes:
+        """Decompressed contents of one blob (staged or on-device)."""
+        key = (id(onode), start)
+        raw = self._blob_raw_cache.get(key)
+        if raw is None:
+            comp = b"".join(
+                self.staged.get(p) or self.store.dev.pread(p * BLOCK, BLOCK)
+                for p in cb.phys)
+            raw = self.store.compressor(cb.alg).decompress(comp[:cb.clen])
+            self._blob_raw_cache[key] = raw
+        return raw
+
+    def plainify(self, onode: Onode, lo: int, hi: int,
+                 full_lo: int = 0, full_hi: int = 0) -> None:
+        """Dissolve any compressed blob overlapping logical blocks
+        [lo, hi): blocks about to be FULLY overwritten ([full_lo,
+        full_hi)) become holes (no decompress needed for them); the
+        rest rematerialize as plain COW blocks. The reference
+        garbage-collects overwritten compressed extents the same way
+        (BlueStore.cc _do_write / gc). Blob physical blocks are freed."""
+        for start in [s for s, cb in onode.cblobs.items()
+                      if s < hi and s + cb.nblocks > lo]:
+            cb = onode.cblobs[start]
+            keep = [bi for bi in range(start, start + cb.nblocks)
+                    if not full_lo <= bi < full_hi]
+            raw = self.blob_raw(onode, start, cb) if keep else b""
+            for bi in range(start, start + cb.nblocks):
+                onode.blocks[bi] = HOLE  # reassign must not free CBLOB
+                onode.csums[bi] = 0
+            for bi in keep:
+                piece = raw[(bi - start) * BLOCK:(bi - start + 1) * BLOCK]
+                if piece != _ZERO_BLOCK:
+                    self.reassign(onode, bi, piece)
+            for p in cb.phys:
+                self._free_phys(p)
+            del onode.cblobs[start]
+            self._blob_raw_cache.pop((id(onode), start), None)
+
+    def try_compress(self, onode: Onode, offset: int,
+                     data: bytes) -> tuple[int, int]:
+        """Compress the aligned full-block prefix of this write into
+        blobs (_do_write_compressed role). Returns (consumed_lo_byte,
+        consumed_hi_byte) of the span now owned by blobs; the caller
+        writes the rest plain. Only spans of >= COMPRESS_MIN_BLOCKS
+        aligned blocks are candidates; each blob covers <=
+        COMPRESS_MAX_BLOCKS and must save at least one physical block
+        (required-ratio role) or that chunk stays plain."""
+        store = self.store
+        if store._comp is None or offset % BLOCK:
+            return offset, offset
+        hint = comp_mod.HINT_NONE
+        h = onode.xattrs.get("_alloc_hint")
+        if h is not None and len(h) >= 20:
+            flags = int.from_bytes(h[16:20], "little")
+            if flags & 1:
+                hint = comp_mod.HINT_COMPRESSIBLE
+            elif flags & 2:
+                hint = comp_mod.HINT_INCOMPRESSIBLE
+        if not comp_mod.should_compress(store.compression_mode, hint):
+            return offset, offset
+        nfull = len(data) // BLOCK
+        if nfull < COMPRESS_MIN_BLOCKS:
+            return offset, offset
+        pos = 0
+        while nfull - pos >= COMPRESS_MIN_BLOCKS:
+            nb = min(COMPRESS_MAX_BLOCKS, nfull - pos)
+            chunk = data[pos * BLOCK:(pos + nb) * BLOCK]
+            out = comp_mod.compress_blob(
+                store._comp, chunk, store.compression_required_ratio)
+            need = -(-len(out) // BLOCK) if out is not None else nb
+            start = offset // BLOCK + pos
+            if out is None or need >= nb:
+                # incompressible chunk: leave it (and everything after
+                # — same data character) to the plain path
+                break
+            for bi in range(start, start + nb):
+                self.punch(onode, bi)  # free old plain phys (blobs were
+                #                        dissolved by plainify already)
+            padded = out + b"\x00" * (need * BLOCK - len(out))
+            phys = [self.alloc_block(padded[i * BLOCK:(i + 1) * BLOCK])
+                    for i in range(need)]
+            for bi in range(start, start + nb):
+                onode.blocks[bi] = CBLOB
+            onode.cblobs[start] = CBlob(
+                nb, phys, len(out), store._comp.name, [0] * need)
+            self._blob_raw_cache[(id(onode), start)] = chunk
+            pos += nb
+        return offset, offset + pos * BLOCK
 
     def defer_patch(self, onode: Onode, bi: int, data: bytes) -> None:
         """In-place small overwrite of an existing block: no new
@@ -263,6 +441,20 @@ class _Txc:
         end = offset + len(data)
         small = len(data) <= DEFER_MAX_BYTES
         self.grow(onode, max(end, onode.size))
+        # dissolve compressed blobs under the write; fully-covered
+        # blocks need no rematerialization
+        full_lo, full_hi = -(-offset // BLOCK), end // BLOCK
+        self.plainify(onode, offset // BLOCK, -(-end // BLOCK),
+                      full_lo, max(full_lo, full_hi))
+        # compress the aligned full-block prefix into blobs
+        clo, chi = self.try_compress(onode, offset, data)
+        if chi > clo:
+            onode.size = max(onode.size, chi)
+            data = data[chi - offset:]
+            offset = chi
+            if not data:
+                return
+            end = offset + len(data)
         for bi in range(offset // BLOCK, -(-end // BLOCK)):
             b0 = bi * BLOCK
             lo, hi = max(offset, b0), min(end, b0 + BLOCK)
@@ -286,6 +478,9 @@ class _Txc:
         end = offset + length
         small = length <= DEFER_MAX_BYTES
         self.grow(onode, max(end, onode.size))
+        full_lo, full_hi = -(-offset // BLOCK), end // BLOCK
+        self.plainify(onode, offset // BLOCK, -(-end // BLOCK),
+                      full_lo, max(full_lo, full_hi))
         for bi in range(offset // BLOCK, -(-end // BLOCK)):
             b0 = bi * BLOCK
             lo, hi = max(offset, b0), min(end, b0 + BLOCK)
@@ -304,6 +499,24 @@ class _Txc:
     def truncate(self, onode: Onode, size: int) -> None:
         if size < onode.size:
             nb = -(-size // BLOCK)
+            # blobs straddling the BYTE cut: rematerialize the kept
+            # prefix (incl. a partial tail block, which must become a
+            # plain block so the tail-zeroing below can patch it);
+            # blobs fully past it: free wholesale
+            boundary = nb - 1 if size % BLOCK else nb
+            for start in [s for s, cb in onode.cblobs.items()
+                          if s + cb.nblocks > boundary]:
+                cb = onode.cblobs[start]
+                if start >= nb:
+                    for p in cb.phys:
+                        self._free_phys(p)
+                    for bi in range(start, start + cb.nblocks):
+                        onode.blocks[bi] = HOLE
+                    del onode.cblobs[start]
+                    self._blob_raw_cache.pop((id(onode), start), None)
+                else:
+                    self.plainify(onode, start, start + cb.nblocks,
+                                  nb, start + cb.nblocks)
             for bi in range(nb, len(onode.blocks)):
                 if onode.blocks[bi] != HOLE:
                     self.freed.append(onode.blocks[bi])
@@ -385,7 +598,7 @@ class _Txc:
             if oid not in c:
                 raise NotFound(repr(oid))
             o = c.pop(oid)
-            self.freed.extend(b for b in o.blocks if b != HOLE)
+            self.free_onode_blocks(o)
             self.dirty.add((cid, oid))
             return
         if code == tx.OP_CLONE:
@@ -394,21 +607,29 @@ class _Txc:
                 raise NotFound(repr(oid))
             src = c[oid]
             if a["dest"] in c:  # clobbered clone target: free old blocks
-                self.freed.extend(
-                    b for b in c[a["dest"]].blocks if b != HOLE)
+                self.free_onode_blocks(c[a["dest"]])
             dst = Onode()
             dst.size = src.size
             dst.xattrs = dict(src.xattrs)
             dst.omap = dict(src.omap)
             dst.omap_header = src.omap_header
             for bi, phys in enumerate(src.blocks):
-                if phys == HOLE:
-                    dst.blocks.append(HOLE)
+                if phys in (HOLE, CBLOB):
+                    dst.blocks.append(phys)
                     dst.csums.append(0)
                 else:  # eager copy (block sharing + refcounts: future)
                     dst.blocks.append(self.alloc_block(
                         self.block_bytes(src, bi)))
                     dst.csums.append(0)
+            for start, cb in src.cblobs.items():
+                # copy the COMPRESSED bytes verbatim — no decompression
+                new_phys = [
+                    self.alloc_block(
+                        self.staged.get(p)
+                        or self.store.dev.pread(p * BLOCK, BLOCK))
+                    for p in cb.phys]
+                dst.cblobs[start] = CBlob(cb.nblocks, new_phys, cb.clen,
+                                          cb.alg, list(cb.csums))
             c[a["dest"]] = dst
             self.dirty.add((cid, a["dest"]))
             return
@@ -465,13 +686,23 @@ class _Txc:
 class BlueStoreLite(ObjectStore):
     def __init__(self, path: str, size: int = 1 << 30, fsync: bool = False,
                  device_csum: bool = False, io_threads: int = 4,
-                 kv_compact_bytes: int = 64 << 20):
+                 kv_compact_bytes: int = 64 << 20,
+                 compression: str | None = None,
+                 compression_mode: str = "aggressive",
+                 compression_required_ratio: float = 0.875):
         self.path = str(path)
         self.dev_size = size
         self.fsync = fsync
         self.device_csum = device_csum
         self.io_threads = io_threads
         self.kv_compact_bytes = kv_compact_bytes
+        # inline blob compression (bluestore_compression_algorithm/mode
+        # roles; default off, like the reference)
+        self._comp = comp_mod.create(compression) if compression else None
+        self.compression_mode = (compression_mode if compression
+                                 else comp_mod.MODE_NONE)
+        self.compression_required_ratio = compression_required_ratio
+        self._decomps: dict[str, comp_mod.Compressor] = {}
         self.kv: rt.NativeKV | None = None
         self.dev: rt.BlockDevice | None = None
         self.alloc: rt.BitmapAllocator | None = None
@@ -479,6 +710,15 @@ class BlueStoreLite(ObjectStore):
         self.lock = threading.RLock()
         self._csum = Checksummer(alg="crc32c", csum_block_size=BLOCK)
         self._mounted = False
+
+    def compressor(self, alg: str) -> comp_mod.Compressor:
+        """Decompressor lookup by the algorithm recorded in the blob —
+        a store reopened with a different (or no) write-side algorithm
+        must still read existing blobs."""
+        c = self._decomps.get(alg)
+        if c is None:
+            c = self._decomps[alg] = comp_mod.create(alg)
+        return c
 
     # ---------------------------------------------------------- lifecycle
 
@@ -509,7 +749,10 @@ class BlueStoreLite(ObjectStore):
             o = Onode.decode(v)
             self.colls.setdefault(cid, {})[oid] = o
             for phys in o.blocks:  # allocator rebuild reclaims orphans
-                if phys != HOLE:
+                if phys not in (HOLE, CBLOB):
+                    self.alloc.mark_used(phys, 1)
+            for cb in o.cblobs.values():
+                for phys in cb.phys:
                     self.alloc.mark_used(phys, 1)
         for k, v in self.kv.scan_prefix(K_HEAD):
             cid, oid = self._split_okey(k[1:])
@@ -586,6 +829,10 @@ class BlueStoreLite(ObjectStore):
                 for bi, phys in enumerate(o.blocks):
                     if phys in crc_of:
                         o.csums[bi] = crc_of[phys]
+                for cb in o.cblobs.values():
+                    for i, phys in enumerate(cb.phys):
+                        if phys in crc_of:
+                            cb.csums[i] = crc_of[phys]
             # AIO_WAIT: COW data must be on the device before the kv
             # commit (deferred blocks wait until AFTER it — the defer
             # record in the batch is their durability)
@@ -689,26 +936,54 @@ class BlueStoreLite(ObjectStore):
                 return b""
             lo_b, hi_b = offset // BLOCK, -(-end // BLOCK)
             idx = [bi for bi in range(lo_b, hi_b)
-                   if bi < len(o.blocks) and o.blocks[bi] != HOLE]
+                   if bi < len(o.blocks)
+                   and o.blocks[bi] not in (HOLE, CBLOB)]
             datas = {bi: self.dev.pread(o.blocks[bi] * BLOCK, BLOCK)
                      for bi in idx}
-            if idx:  # batched verify_csum (BlueStore.cc:11277 role)
-                arr = np.frombuffer(
-                    b"".join(datas[bi] for bi in idx), np.uint8
-                ).reshape(len(idx), BLOCK)
+            # compressed blobs touched by the range: read their
+            # physical blocks; verification joins the one batched call
+            blobs: dict[int, CBlob] = {
+                s: cb for s, cb in o.cblobs.items()
+                if s < hi_b and s + cb.nblocks > lo_b}
+            blob_comp = {s: [self.dev.pread(p * BLOCK, BLOCK)
+                             for p in cb.phys]
+                         for s, cb in blobs.items()}
+            rows = [datas[bi] for bi in idx]
+            want_l = [o.csums[bi] for bi in idx]
+            where = [f"block {bi}" for bi in idx]
+            for s, cb in blobs.items():
+                rows.extend(blob_comp[s])
+                want_l.extend(cb.csums)
+                where.extend(f"cblob@{s} phys[{i}]"
+                             for i in range(len(cb.phys)))
+            if rows:  # batched verify_csum (BlueStore.cc:11277 role)
+                arr = np.frombuffer(b"".join(rows), np.uint8
+                                    ).reshape(len(rows), BLOCK)
                 got = self._csum.calculate(arr, device=self.device_csum)
-                want = np.array([o.csums[bi] for bi in idx], np.uint32)
+                want = np.array(want_l, np.uint32)
                 bad = np.nonzero(got != want)[0]
                 if bad.size:
-                    bi = idx[int(bad[0])]
+                    j = int(bad[0])
                     raise StoreError(
-                        f"csum mismatch on {cid}/{oid!r} block {bi}: "
-                        f"stored {o.csums[bi]:#x} != actual "
-                        f"{int(got[int(bad[0])]):#x}")
+                        f"csum mismatch on {cid}/{oid!r} {where[j]}: "
+                        f"stored {want_l[j]:#x} != actual "
+                        f"{int(got[j]):#x}")
+            raw = {s: self.compressor(cb.alg).decompress(
+                       b"".join(blob_comp[s])[:cb.clen])
+                   for s, cb in blobs.items()}
             parts = []
             for bi in range(lo_b, hi_b):
                 b0 = bi * BLOCK
-                blkdata = datas.get(bi, _ZERO_BLOCK)
+                if bi in datas:
+                    blkdata = datas[bi]
+                elif (bi < len(o.blocks) and o.blocks[bi] == CBLOB):
+                    hit = o.find_cblob(bi)
+                    assert hit is not None
+                    s = hit[0]
+                    blkdata = raw[s][(bi - s) * BLOCK:
+                                     (bi - s + 1) * BLOCK]
+                else:
+                    blkdata = _ZERO_BLOCK
                 parts.append(blkdata[max(offset, b0) - b0:
                                      min(end, b0 + BLOCK) - b0])
             return b"".join(parts)

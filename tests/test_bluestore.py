@@ -436,3 +436,230 @@ def test_deferred_vs_cow_write_amplification(tmp_path):
         data[off : off + 64] = b"x" * 64
     assert s.read("c", b"o") == bytes(data)
     s.umount()
+
+
+# ------------------------------------------------------ inline compression
+
+
+def comp_store(tmp_path, **kw):
+    kw.setdefault("compression", "zlib")
+    return make_store(tmp_path, **kw)
+
+
+def test_compressed_write_saves_blocks_and_roundtrips(tmp_path):
+    s = comp_store(tmp_path)
+    t = tx.Transaction().create_collection("c")
+    s.apply_transaction(t)
+    used0 = s.alloc.used
+    data = b"compress me " * (64 * 1024 // 12 + 1)  # > 64 KiB, squashy
+    t = tx.Transaction()
+    t.write("c", b"o", 0, data)
+    s.apply_transaction(t)
+    nblocks = -(-len(data) // BLOCK)
+    assert s.alloc.used - used0 < nblocks  # physically smaller
+    assert s.read("c", b"o") == data
+    assert s.read("c", b"o", 5000, 9000) == data[5000:14000]
+    s.umount()
+
+
+def test_compressed_survives_remount_without_write_codec(tmp_path):
+    data = bytes(range(256)) * 300  # 75 KiB, compressible
+    s = comp_store(tmp_path)
+    t = tx.Transaction().create_collection("c")
+    t.write("c", b"o", 0, data)
+    s.apply_transaction(t)
+    used = s.alloc.used
+    s.umount()
+    # reopen with compression OFF: existing blobs must still decode
+    # (the blob records its algorithm)
+    s2 = make_store(tmp_path)
+    assert s2.alloc.used == used  # allocator rebuilt incl. blob blocks
+    assert s2.read("c", b"o") == data
+    # new writes on the uncompressed store stay plain, old data intact
+    t = tx.Transaction()
+    t.write("c", b"p", 0, data)
+    s2.apply_transaction(t)
+    assert s2.read("c", b"p") == data
+    s2.umount()
+
+
+def test_incompressible_falls_through_plain(tmp_path):
+    import numpy as np
+
+    s = comp_store(tmp_path)
+    rng = np.random.default_rng(7)
+    data = rng.integers(0, 256, 128 * 1024, dtype=np.uint8).tobytes()
+    t = tx.Transaction().create_collection("c")
+    s.apply_transaction(t)
+    used0 = s.alloc.used
+    t = tx.Transaction()
+    t.write("c", b"o", 0, data)
+    s.apply_transaction(t)
+    assert s.alloc.used - used0 == len(data) // BLOCK  # stored raw
+    assert not s.colls["c"][b"o"].cblobs
+    assert s.read("c", b"o") == data
+    s.umount()
+
+
+def test_alloc_hint_incompressible_skips_compression(tmp_path):
+    data = b"Z" * (64 * 1024)
+    s = comp_store(tmp_path)  # mode=aggressive honors the hint
+    t = tx.Transaction().create_collection("c")
+    t.set_alloc_hint("c", b"o", 0, 0, 2)  # FLAG_INCOMPRESSIBLE
+    t.write("c", b"o", 0, data)
+    s.apply_transaction(t)
+    assert not s.colls["c"][b"o"].cblobs
+    assert s.read("c", b"o") == data
+    s.umount()
+
+
+def test_partial_overwrite_dissolves_blob(tmp_path):
+    data = b"ab" * (48 * 1024 // 2)
+    s = comp_store(tmp_path)
+    t = tx.Transaction().create_collection("c")
+    t.write("c", b"o", 0, data)
+    s.apply_transaction(t)
+    assert s.colls["c"][b"o"].cblobs
+    patch_off, patch = 10_000, b"PATCHED!"
+    t = tx.Transaction()
+    t.write("c", b"o", patch_off, patch)
+    s.apply_transaction(t)
+    want = data[:patch_off] + patch + data[patch_off + len(patch):]
+    assert s.read("c", b"o") == want
+    # the touched blob is gone; untouched one(s) may remain
+    o = s.colls["c"][b"o"]
+    for start, cb in o.cblobs.items():
+        assert not start <= patch_off // BLOCK < start + cb.nblocks
+    s.umount()
+
+
+def test_full_overwrite_recompresses_and_frees_old(tmp_path):
+    d1 = b"first " * (32 * 1024 // 6)
+    d2 = b"second" * (32 * 1024 // 6)
+    s = comp_store(tmp_path)
+    t = tx.Transaction().create_collection("c")
+    t.write("c", b"o", 0, d1)
+    s.apply_transaction(t)
+    used1 = s.alloc.used
+    t = tx.Transaction()
+    t.write("c", b"o", 0, d2)
+    s.apply_transaction(t)
+    assert abs(s.alloc.used - used1) <= 1  # old blob blocks freed
+    assert s.read("c", b"o") == d2
+    s.umount()
+
+
+def test_truncate_into_blob(tmp_path):
+    data = b"trunc" * (64 * 1024 // 5)
+    s = comp_store(tmp_path)
+    t = tx.Transaction().create_collection("c")
+    t.write("c", b"o", 0, data)
+    s.apply_transaction(t)
+    cut = 20_000
+    t = tx.Transaction()
+    t.truncate("c", b"o", cut)
+    s.apply_transaction(t)
+    assert s.read("c", b"o") == data[:cut]
+    t = tx.Transaction()  # re-extend: stale tail must read zero
+    t.truncate("c", b"o", len(data))
+    s.apply_transaction(t)
+    assert s.read("c", b"o") == data[:cut] + b"\x00" * (len(data) - cut)
+    s.umount()
+
+
+def test_clone_copies_compressed_verbatim(tmp_path):
+    data = b"clone me " * (48 * 1024 // 9)
+    s = comp_store(tmp_path)
+    t = tx.Transaction().create_collection("c")
+    t.write("c", b"src", 0, data)
+    t.clone("c", b"src", b"dst")
+    s.apply_transaction(t)
+    src, dst = s.colls["c"][b"src"], s.colls["c"][b"dst"]
+    assert set(src.cblobs) == set(dst.cblobs)
+    for st in src.cblobs:
+        assert src.cblobs[st].phys != dst.cblobs[st].phys  # no sharing
+        assert src.cblobs[st].clen == dst.cblobs[st].clen
+    t = tx.Transaction()  # mutating the clone leaves the source alone
+    t.write("c", b"dst", 0, b"X" * 100)
+    s.apply_transaction(t)
+    assert s.read("c", b"src") == data
+    assert s.read("c", b"dst")[:100] == b"X" * 100
+    s.umount()
+
+
+def test_csum_detects_rot_in_compressed_blob(tmp_path):
+    data = b"rot" * (64 * 1024 // 3)
+    s = comp_store(tmp_path)
+    t = tx.Transaction().create_collection("c")
+    t.write("c", b"o", 0, data)
+    s.apply_transaction(t)
+    o = s.colls["c"][b"o"]
+    assert o.cblobs
+    cb = next(iter(o.cblobs.values()))
+    phys = cb.phys[0]
+    buf = bytearray(s.dev.pread(phys * BLOCK, BLOCK))
+    buf[17] ^= 0x40
+    s.dev.pwrite(phys * BLOCK, bytes(buf))
+    with pytest.raises(StoreError, match="csum mismatch"):
+        s.read("c", b"o")
+    s.umount()
+
+
+def test_compressed_remove_releases_blob_blocks(tmp_path):
+    data = b"gone " * (64 * 1024 // 5)
+    s = comp_store(tmp_path)
+    t = tx.Transaction().create_collection("c")
+    s.apply_transaction(t)
+    used0 = s.alloc.used
+    t = tx.Transaction()
+    t.write("c", b"o", 0, data)
+    s.apply_transaction(t)
+    t = tx.Transaction()
+    t.remove("c", b"o")
+    s.apply_transaction(t)
+    assert s.alloc.used == used0
+    s.umount()
+
+
+def test_compressed_crash_reopen(tmp_path):
+    """Blob written, no umount: mount rebuilds onode + blob from kv."""
+    data = b"durable " * (32 * 1024 // 8)
+    s = comp_store(tmp_path)
+    t = tx.Transaction().create_collection("c")
+    t.write("c", b"o", 0, data)
+    s.apply_transaction(t)
+    s2 = comp_store(tmp_path)  # no umount of s: crash-equivalent
+    assert s2.read("c", b"o") == data
+    assert s2.colls["c"][b"o"].cblobs
+    s2.umount()
+
+
+def test_truncate_blob_at_partial_tail_block(tmp_path):
+    """A blob ending exactly at the truncation block with a partial
+    tail must dissolve so the tail zeroing patches a plain block (a
+    CBLOB sentinel must never reach the allocator free list)."""
+    s = comp_store(tmp_path)
+    t = tx.Transaction().create_collection("c")
+    t.write("c", b"o", 0, b"tail" * (16 * 1024 // 4))  # one 4-block blob
+    s.apply_transaction(t)
+    assert s.colls["c"][b"o"].cblobs
+    cut = 14336  # 3.5 blocks
+    t = tx.Transaction()
+    t.truncate("c", b"o", cut)
+    s.apply_transaction(t)
+    o = s.colls["c"][b"o"]
+    assert not o.cblobs
+    assert all(b != 0xFFFFFFFE for b in o.blocks)
+    assert s.read("c", b"o") == (b"tail" * (16 * 1024 // 4))[:cut]
+    t = tx.Transaction()  # re-extend: truncated tail reads zero
+    t.truncate("c", b"o", 16 * 1024)
+    s.apply_transaction(t)
+    assert s.read("c", b"o", cut) == b"\x00" * (16 * 1024 - cut)
+    # overwrite block 0 afterwards: no stale blob resurrects the tail
+    t = tx.Transaction()
+    t.write("c", b"o", 0, b"X" * 10)
+    s.apply_transaction(t)
+    got = s.read("c", b"o")
+    assert got[:10] == b"X" * 10
+    assert got[cut:] == b"\x00" * (16 * 1024 - cut)
+    s.umount()

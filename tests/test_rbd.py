@@ -302,3 +302,34 @@ def test_object_cacher_rbd_write_back_and_fence():
         await c.stop()
 
     run(t())
+
+
+def test_cache_coherent_across_rollback_and_shrink():
+    """snap_rollback and shrink mutate objects server-side with the
+    RAW client; a cached image must not serve (or later re-flush)
+    pre-rollback / past-the-cut bytes (round-5 review finding)."""
+    async def t():
+        c, rbd = await make()
+        await rbd.create("disk", 8 * 8192, LAYOUT)
+        img = await rbd.open("disk", cache=True)
+        await img.write(0, b"A" * 8192)
+        await img.snap_create("s")          # fence: A is in the snap
+        assert await img.read(0, 8192) == b"A" * 8192  # cached clean
+        await img.write(0, b"B" * 8192)     # buffered dirty
+        await img.snap_rollback("s")
+        # rollback wins over both the cached clean A-copy and the
+        # buffered B write (flushed before the rollback rewrote it)
+        assert await img.read(0, 8192) == b"A" * 8192
+        img2 = await rbd.open("disk")
+        assert await img2.read(0, 8192) == b"A" * 8192
+
+        # shrink: cached bytes past the cut must die with the resize
+        await img.write(8192, b"C" * 8192)
+        assert await img.read(8192, 8192) == b"C" * 8192
+        await img.resize(8192 + 100)
+        await img.resize(2 * 8192)
+        tail = await img.read(8192, 8192)
+        assert tail == b"C" * 100 + b"\x00" * (8192 - 100)
+        await c.stop()
+
+    run(t())
