@@ -21,8 +21,9 @@ costs ~105 ms — both round-1 numbers were artifacts):
 - completion is forced by reading back a value that DEPENDS on every
   timed output (async-dispatch + block_until_ready measures dispatch,
   not execution, over the tunnel);
-- the fixed round-trip latency is measured separately with a trivial
-  kernel and subtracted; iteration counts keep it a minor correction;
+- the fixed round-trip cost cancels exactly by differencing paired
+  half/full-length chains (the measured tunnel latency is reported as
+  its own metric and still subtracted in the one single-run config);
 - every timed iteration consumes a provably distinct input: a pre-staged
   base XORed with a per-iteration salt (the Pallas kernel is opaque to
   XLA fusion, so the salted copy costs one extra HBM write+read of the
@@ -78,6 +79,11 @@ THREADS = os.cpu_count() or 1
 HBM_BYTES_PER_S = 819e9
 ROOFLINE_SLACK = 1.25  # measurement noise allowance
 
+#: how each _timed_chain estimate was obtained this run ("differenced"
+#: = paired-min difference; "conservative" = full chain with fixed
+#: costs included) — reported in the output JSON for honesty
+_TIMING_MODES: list = []
+
 
 def _sync(x) -> None:
     """Force actual completion of everything x depends on (device_get of
@@ -103,33 +109,77 @@ def measure_latency() -> float:
     return float(np.median(samples))
 
 
-def _timed_chain(fn, salts, latency: float) -> float:
-    """Seconds per call of fn(salt), latency-subtracted, MIN over three
-    chains.
+def _timed_chain(fn, salts,
+                 traffic_bytes: float | None = None) -> float:
+    """Seconds per call of fn(salt), fixed costs cancelled by
+    differencing the MINIMA of half-length and full-length chains.
 
     fn must return a small array depending on all its work. One readback
-    forces the whole chain; per-call cost amortizes the round trip. The
-    min-of-3 is the contention-floor estimate: the dev chip rides a
-    SHARED relay whose throughput swings 3x+ minute to minute
-    (BASELINE.md "Tunnel variability"), and the least-contended chain
-    is the closest observable to the kernel's real cost — each chain
-    still runs len(salts) distinct salted iterations under the
-    roofline tripwire, so no single-shot cache artifact can win.
+    forces the whole chain; per-call cost amortizes the round trip.
+    Three half chains and three full chains are timed; the estimate is
+    (min(full) - min(half)) / (n - n/2). Each min is the
+    least-contended observation of (fixed + iters*dt) on a SHARED
+    relay whose throughput swings 3x+ minute to minute (BASELINE.md
+    "Tunnel variability"), so the fixed round-trip cost cancels
+    exactly — no stale startup-latency subtraction (which once made
+    per-iteration time impossibly small and tripped the roofline
+    guard) — and a contention stall in any single chain cannot fake a
+    small dt (a per-pair difference could; "pick the plausible pair"
+    repairs just laundered the artifact into a roofline-level claim).
+    Every chain runs distinct salted iterations, so no single-shot
+    cache artifact can win. If the difference is non-positive or
+    still implies impossible HBM traffic, fall back to the full chain
+    with NO subtraction (conservative: overstates cost) and record the
+    mode in _TIMING_MODES; only impossible-even-unsubtracted timing
+    raises.
     """
     # warm chain: compiles fn AND the scalar sum-tree kernels (their
     # first-use compile otherwise lands inside the timed region)
     warm = [fn(s) for s in salts[:2]]
     _sync(sum(jnp.sum(p.astype(jnp.uint32)) for p in warm))
 
-    best = float("inf")
-    for _ in range(3):
+    def chain(ss) -> float:
         t0 = time.perf_counter()  # clock covers dispatch too — execution
-        probes = [fn(s) for s in salts]  # begins at the first enqueue
+        probes = [fn(s) for s in ss]  # begins at the first enqueue
         acc = sum(jnp.sum(p.astype(jnp.uint32)) for p in probes)
         _sync(acc)
-        wall = time.perf_counter() - t0
-        best = min(best, max(wall - latency, 1e-9) / len(salts))
-    return best
+        return time.perf_counter() - t0
+
+    half = len(salts) // 2
+    halves = []
+    fulls = []
+    for _ in range(3):
+        halves.append(chain(salts[:half]))
+        fulls.append(chain(salts))
+    # difference the MINIMA of the two populations: each min is the
+    # least-contended observation of (fixed + n*dt), so their
+    # difference estimates dt with the contention spikes of any single
+    # pair excluded (a per-pair difference once went near zero when a
+    # stall landed in the half chain, and any "pick the plausible
+    # pair" repair just launders that artifact into a roofline-level
+    # claim)
+    dt = (min(fulls) - min(halves)) / (len(salts) - half)
+    conservative = min(fulls) / len(salts)  # fixed cost included
+    if dt <= 0:
+        _TIMING_MODES.append("conservative")
+        return conservative
+    if traffic_bytes is not None:
+        floor = traffic_bytes / (HBM_BYTES_PER_S * ROOFLINE_SLACK)
+        if dt < floor:
+            if conservative < floor:
+                raise RuntimeError(
+                    f"implied HBM bandwidth "
+                    f"{traffic_bytes / conservative / 1e9:.0f} GB/s "
+                    f"exceeds the chip spec "
+                    f"{HBM_BYTES_PER_S / 1e9:.0f} GB/s even with no "
+                    "fixed-cost subtraction — timing loop is "
+                    "measuring dispatch, not execution")
+            # transient tunnel weirdness: report the honest slower
+            # number rather than a manufactured roofline figure
+            _TIMING_MODES.append("conservative")
+            return conservative
+    _TIMING_MODES.append("differenced")
+    return dt
 
 
 def headline(latency: float) -> dict:
@@ -181,11 +231,14 @@ def headline(latency: float) -> dict:
     _sync(enc_probe(salts[0]))
     _sync(dec_probe(salts[0]))
     _sync(rt_probe(salts[0]))
-    dt_enc = _timed_chain(enc_probe, salts, latency)
-    dt_dec = _timed_chain(dec_probe, salts, latency)
-    dt = _timed_chain(rt_probe, salts, latency)
-
+    # per-iteration HBM floor: each chain reads the data batch once
     data_bytes = BATCH * K * CHUNK
+    dt_enc = _timed_chain(enc_probe, salts,
+                          traffic_bytes=data_bytes)
+    dt_dec = _timed_chain(dec_probe, salts,
+                          traffic_bytes=data_bytes)
+    dt = _timed_chain(rt_probe, salts,
+                      traffic_bytes=data_bytes)
     # Tripwire floor on HBM traffic per fused iteration: ONE read of
     # the data batch (XLA single-reads it for both fused passes; the
     # salt XOR and the small parity/decoded outputs add more, which
@@ -277,6 +330,7 @@ def headline(latency: float) -> dict:
         "host_threads": THREADS,
         "hbm_roofline_frac": round(implied / HBM_BYTES_PER_S, 3),
         "tunnel_latency_ms": round(latency * 1e3, 1),
+        "timing_modes": list(_TIMING_MODES),
         "roundtrip_ms": round(dt * 1e3, 2),
         "encode_ms": round(dt_enc * 1e3, 2),
         "decode_ms": round(dt_dec * 1e3, 2),
@@ -307,7 +361,7 @@ def config1_small_stripe(latency: float) -> dict:
 
     salts = [jnp.uint32(17 * (i + 1)) for i in range(100)]
     _sync(enc_probe(salts[0]))
-    dev_us = _timed_chain(enc_probe, salts, latency) * 1e6
+    dev_us = _timed_chain(enc_probe, salts) * 1e6
     return {
         "host_encode_us": round(host_us, 1),
         "device_encode_us_amortized": round(dev_us, 1),
@@ -339,7 +393,7 @@ def config4_crc32c(latency: float) -> dict:
     salts = [jnp.uint32(0x01000193 * (i + 1) & 0xFFFFFFFF)
              for i in range(96)]
     _sync(crc_probe(salts[0]))
-    dt = _timed_chain(crc_probe, salts, latency)
+    dt = _timed_chain(crc_probe, salts)
     gibs_dev = nblobs * blob / dt / 2**30
 
     # guard: salted stream vs the host hw-accelerated CRC

@@ -19,6 +19,8 @@ Built-in classes mirror the reference's most-used ones:
 """
 from __future__ import annotations
 
+import time
+
 from ..utils import denc
 
 RD = 1
@@ -130,10 +132,14 @@ def _lock_attr(name: str) -> str:
     return f"lock.{name}"
 
 
-def _enc_lock(ltype: str, holders: list[tuple[str, str]]) -> bytes:
+def _enc_lock(ltype: str,
+              holders: list[tuple[str, str, int]]) -> bytes:
+    """Holder = (owner, cookie, expiry_ms). expiry_ms == 0 means the
+    lock never expires (cls_lock's duration=0 semantics)."""
     return denc.enc_str(ltype) + denc.enc_list(
         holders,
-        lambda h: denc.enc_str(h[0]) + denc.enc_str(h[1]),
+        lambda h: (denc.enc_str(h[0]) + denc.enc_str(h[1])
+                   + denc.enc_u64(h[2])),
     )
 
 
@@ -143,32 +149,50 @@ def _dec_lock(b: bytes):
     def one(buf, o):
         owner, o = denc.dec_str(buf, o)
         cookie, o = denc.dec_str(buf, o)
-        return (owner, cookie), o
+        expiry, o = denc.dec_u64(buf, o)
+        return (owner, cookie, expiry), o
 
     holders, _ = denc.dec_list(b, off, one)
     return ltype, holders
 
 
+def _live_holders(holders):
+    """Drop expired holders (cls_lock duration role): a holder that
+    never renewed past its expiry no longer holds anything — this is
+    what makes a SIGKILLed lock owner self-healing."""
+    now_ms = int(time.time() * 1000)
+    return [h for h in holders if h[2] == 0 or h[2] > now_ms]
+
+
 @register("lock", "lock", RD | WR)
 def lock_lock(ctx: ClsContext, inp: bytes) -> bytes:
-    """input: name, type("exclusive"|"shared"), owner, cookie."""
+    """input: name, type("exclusive"|"shared"), owner, cookie
+    [, duration_ms] — a nonzero duration makes the grant auto-expire
+    unless renewed (re-locking with the same owner+cookie refreshes
+    the expiry, the renewal arc)."""
     name, off = denc.dec_str(inp, 0)
     ltype, off = denc.dec_str(inp, off)
     owner, off = denc.dec_str(inp, off)
-    cookie, _ = denc.dec_str(inp, off)
+    cookie, off = denc.dec_str(inp, off)
+    duration_ms = 0
+    if off < len(inp):
+        duration_ms, off = denc.dec_u64(inp, off)
+    expiry = (int(time.time() * 1000) + duration_ms
+              if duration_ms else 0)
     if ltype not in ("exclusive", "shared"):
         raise ClsError(_EINVAL, f"lock type {ltype!r}")
     raw = ctx.getxattr(_lock_attr(name))
-    if raw is None:
-        ctx.setxattr(_lock_attr(name), _enc_lock(ltype, [(owner, cookie)]))
-        return b""
-    cur_type, holders = _dec_lock(raw)
-    if (owner, cookie) in holders:
-        return b""  # re-entrant grant
-    if cur_type == "exclusive" or ltype == "exclusive":
+    holders = _live_holders(_dec_lock(raw)[1]) if raw else []
+    cur_type = _dec_lock(raw)[0] if raw else ltype
+    mine = [h for h in holders if (h[0], h[1]) == (owner, cookie)]
+    if mine:
+        holders.remove(mine[0])  # renewal: refresh the expiry below
+    elif holders and (cur_type == "exclusive" or ltype == "exclusive"):
         raise ClsError(_EBUSY, f"lock {name} held")
-    holders.append((owner, cookie))
-    ctx.setxattr(_lock_attr(name), _enc_lock(cur_type, holders))
+    holders.append((owner, cookie, expiry))
+    ctx.setxattr(_lock_attr(name),
+                 _enc_lock(cur_type if holders[:-1] else ltype,
+                           holders))
     return b""
 
 
@@ -181,9 +205,11 @@ def lock_unlock(ctx: ClsContext, inp: bytes) -> bytes:
     if raw is None:
         raise ClsError(_ENOENT, f"lock {name}")
     ltype, holders = _dec_lock(raw)
-    if (owner, cookie) not in holders:
+    holders = _live_holders(holders)
+    mine = [h for h in holders if (h[0], h[1]) == (owner, cookie)]
+    if not mine:
         raise ClsError(_ENOENT, f"{owner}/{cookie} does not hold {name}")
-    holders.remove((owner, cookie))
+    holders.remove(mine[0])
     if holders:
         ctx.setxattr(_lock_attr(name), _enc_lock(ltype, holders))
     else:
@@ -199,6 +225,7 @@ def lock_break(ctx: ClsContext, inp: bytes) -> bytes:
     if raw is None:
         raise ClsError(_ENOENT, f"lock {name}")
     ltype, holders = _dec_lock(raw)
+    holders = _live_holders(holders)
     keep = [h for h in holders if h[0] != owner]
     if len(keep) == len(holders):
         raise ClsError(_ENOENT, f"{owner} holds nothing on {name}")
@@ -213,7 +240,11 @@ def lock_break(ctx: ClsContext, inp: bytes) -> bytes:
 def lock_get_info(ctx: ClsContext, inp: bytes) -> bytes:
     name, _ = denc.dec_str(inp, 0)
     raw = ctx.getxattr(_lock_attr(name))
-    return raw if raw is not None else _enc_lock("none", [])
+    if raw is None:
+        return _enc_lock("none", [])
+    ltype, holders = _dec_lock(raw)
+    live = _live_holders(holders)
+    return _enc_lock(ltype if live else "none", live)
 
 
 # ================================================= built-in: refcount

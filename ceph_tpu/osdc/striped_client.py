@@ -147,9 +147,15 @@ class RadosStriper:
         affected = {ex.oid
                     for ex in file_to_extents(self.layout, size,
                                               old - size, fmt)}
+        # kept tail length of each boundary object: the LAST kept byte
+        # an object holds comes from the final stripe row before the
+        # cut, so one stripe period of extents suffices — walking the
+        # whole kept prefix would make every shrink O(file size)
         keep: dict[bytes, int] = {}
         if size > 0:
-            for ex in file_to_extents(self.layout, 0, size, fmt):
+            period = self.layout.stripe_unit * self.layout.stripe_count
+            lo = max(0, size - period)
+            for ex in file_to_extents(self.layout, lo, size - lo, fmt):
                 keep[ex.oid] = max(keep.get(ex.oid, 0),
                                    ex.offset + ex.length)
 

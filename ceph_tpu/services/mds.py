@@ -807,9 +807,12 @@ class FSClient:
             # wholesale invalidate below must not discard OTHER files'
             # buffered dirty writes
             await self._cacher.flush()
-            # drop cached content: nothing past the cut may be served
-            self._cacher.invalidate()
         await self._req("truncate", path=path, size=size)
+        if self._cacher is not None:
+            # drop cached content AFTER the MDS applied the cut: an
+            # invalidate taken before it leaves a window where a
+            # concurrent read re-caches pre-truncate bytes as clean
+            self._cacher.invalidate()
 
     # ---------------------------------------------------------- snapshots
     #

@@ -409,6 +409,7 @@ class Image:
         def one(b, o):
             owner, o = denc.dec_str(b, o)
             cookie, o = denc.dec_str(b, o)
+            _expiry, o = denc.dec_u64(b, o)  # rbd locks never expire
             return (owner, cookie), o
 
         holders, _ = denc.dec_list(raw, off, one)
@@ -588,11 +589,9 @@ class Image:
         old = self.size
         if new_size < old and self._cacher is not None:
             # shrink mutates objects server-side behind the cache:
-            # land buffered writes first (they precede the resize),
-            # then drop cached content so nothing past the cut is
-            # served or re-flushed later (librbd invalidates too)
+            # land buffered writes first (they precede the resize);
+            # cached content drops AFTER the objects are cut, below
             await self._cacher.flush()
-            self._cacher.invalidate()
         if new_size < old:
             # drop whole objects past the end, truncate the boundary one
             lo = self.layout
@@ -609,6 +608,11 @@ class Image:
                     )
                 except KeyError:
                     pass
+            if self._cacher is not None:
+                # objects are cut: NOW drop the cache (invalidate
+                # before the cut would let a concurrent read re-cache
+                # doomed bytes as clean — librbd's ordering)
+                self._cacher.invalidate()
         await self.client.setxattr(
             self.pool_id, _header(self.name), ATTR_SIZE,
             denc.enc_u64(new_size),
@@ -824,10 +828,9 @@ class Image:
         if self._cacher is not None:
             # rollback rewrites objects server-side via the RAW client:
             # flush pre-rollback buffered writes (they happened before
-            # the rollback), then invalidate so no pre-rollback bytes
-            # are served from cache afterwards
+            # the rollback); the invalidate comes AFTER the rewrite so
+            # a concurrent read can't re-cache pre-rollback bytes
             await self._cacher.flush()
-            self._cacher.invalidate()
         await self.refresh()
         if snap not in self.snaps:
             raise KeyError(snap)
@@ -848,6 +851,8 @@ class Image:
 
         await asyncio.gather(
             *(rb(i) for i in range(self._object_count())))
+        if self._cacher is not None:
+            self._cacher.invalidate()  # see flush note above
 
     async def snap_list(self) -> list[str]:
         await self.refresh()

@@ -164,14 +164,22 @@ def _null_order(mtime: float) -> str:
 
 def _enc_entry(size: int, etag: str, mtime: float,
                multipart: bool = False, vid: str = "",
-               marker: bool = False) -> bytes:
+               marker: bool = False, ctype: str = "",
+               meta: dict[str, str] | None = None) -> bytes:
     """Index entry: size/etag/mtime/multipart plus the versioning
     fields (rgw_bucket_dir_entry role): ``vid`` names the version the
     entry points at ("" = unversioned/null version at the plain data
-    oid) and ``marker`` flags an S3 delete marker."""
-    return (denc.enc_u64(size) + denc.enc_str(etag)
-            + denc.enc_u64(int(mtime)) + denc.enc_u8(multipart)
-            + denc.enc_str(vid) + denc.enc_u8(marker))
+    oid) and ``marker`` flags an S3 delete marker. ``ctype``/``meta``
+    carry the content type and user metadata (x-amz-meta-* /
+    X-Object-Meta-* — the rgw attrs role, indexed so HEAD/listings
+    never touch the data objects)."""
+    out = (denc.enc_u64(size) + denc.enc_str(etag)
+           + denc.enc_u64(int(mtime)) + denc.enc_u8(multipart)
+           + denc.enc_str(vid) + denc.enc_u8(marker))
+    if ctype or meta:
+        out += denc.enc_str(ctype) + denc.enc_map(
+            meta or {}, denc.enc_str, denc.enc_str)
+    return out
 
 
 def _dec_entry(b: bytes) -> dict:
@@ -179,13 +187,17 @@ def _dec_entry(b: bytes) -> dict:
     etag, off = denc.dec_str(b, off)
     mtime, off = denc.dec_u64(b, off)
     multipart, off = denc.dec_u8(b, off)
-    vid, marker = "", 0
+    vid, marker, ctype, meta = "", 0, "", {}
     if off < len(b):  # entries written before versioning lack these
         vid, off = denc.dec_str(b, off)
         marker, off = denc.dec_u8(b, off)
+    if off < len(b):  # and older ones lack the attrs tail
+        ctype, off = denc.dec_str(b, off)
+        meta, off = denc.dec_map(b, off, denc.dec_str, denc.dec_str)
     return {"size": size, "etag": etag, "mtime": mtime,
             "multipart": bool(multipart), "version_id": vid,
-            "delete_marker": bool(marker)}
+            "delete_marker": bool(marker), "content_type": ctype,
+            "meta": meta}
 
 
 class _ClsIndex:
@@ -361,10 +373,13 @@ class RGWLite:
 
     # ------------------------------------------------------------ objects
 
-    async def put_object(self, bucket: str, key: str,
-                         data: bytes) -> str | tuple[str, str]:
+    async def put_object(self, bucket: str, key: str, data: bytes,
+                         content_type: str = "",
+                         meta: dict[str, str] | None = None
+                         ) -> str | tuple[str, str]:
         """Returns the etag; on a versioning-enabled bucket returns
-        (etag, version_id)."""
+        (etag, version_id). ``content_type``/``meta`` ride the index
+        entry (Swift X-Object-Meta-* / S3 x-amz-meta-* role)."""
         await self._require_bucket(bucket)
         etag = hashlib.md5(data).hexdigest()
         if "\x00" in key:
@@ -377,7 +392,8 @@ class RGWLite:
             await self._preserve_null_version(bucket, key)
             await self.client.write_full(
                 self.pool_id, _ver_oid(bucket, key, vid), data)
-            entry = _enc_entry(len(data), etag, now, vid=vid)
+            entry = _enc_entry(len(data), etag, now, vid=vid,
+                               ctype=content_type, meta=meta)
             # the version row, then the current pointer
             await self.index.put(bucket, _ver_index_key(key, vid),
                                  entry)
@@ -390,7 +406,8 @@ class RGWLite:
             await self.striper.remove(oid)  # drop stale striped form
             await self.client.write_full(self.pool_id, oid, data)
         await self.index.put(bucket, key,
-                             _enc_entry(len(data), etag, time.time()))
+                             _enc_entry(len(data), etag, time.time(),
+                                        ctype=content_type, meta=meta))
         return etag
 
     async def _preserve_null_version(self, bucket: str,
@@ -405,7 +422,8 @@ class RGWLite:
         if cur["version_id"] or cur["delete_marker"]:
             return  # already versioned / already preserved
         row = _enc_entry(cur["size"], cur["etag"], cur["mtime"],
-                         multipart=cur["multipart"], vid="null")
+                         multipart=cur["multipart"], vid="null",
+                         ctype=cur["content_type"], meta=cur["meta"])
         await self.index.put(
             bucket, _ver_index_key(key, _null_order(cur["mtime"])),
             row)
@@ -565,14 +583,22 @@ class RGWLite:
                 _enc_entry(ent["size"], ent["etag"], ent["mtime"],
                            multipart=ent["multipart"],
                            vid=ent["version_id"],
-                           marker=ent["delete_marker"]))
+                           marker=ent["delete_marker"],
+                           ctype=ent["content_type"],
+                           meta=ent["meta"]))
         else:
             await self.index.delete(bucket, key)
 
     async def copy_object(self, src_bucket: str, src_key: str,
-                          dst_bucket: str, dst_key: str) -> str:
-        data, _ = await self.get_object(src_bucket, src_key)
-        return await self.put_object(dst_bucket, dst_key, data)
+                          dst_bucket: str, dst_key: str,
+                          meta: dict[str, str] | None = None) -> str:
+        """Server-side copy; source attrs carry over unless ``meta``
+        replaces them (x-amz-metadata-directive REPLACE role)."""
+        data, src = await self.get_object(src_bucket, src_key)
+        return await self.put_object(
+            dst_bucket, dst_key, data,
+            content_type=src["content_type"],
+            meta=src["meta"] if meta is None else meta)
 
     async def list_objects(self, bucket: str, prefix: str = "",
                            marker: str = "", max_keys: int = 1000):
@@ -808,7 +834,68 @@ def _xml(root: ET.Element) -> bytes:
             + ET.tostring(root))
 
 
-class S3Frontend:
+class HttpFrontend:
+    """Shared asyncio HTTP/1.1 server plumbing (rgw_asio_frontend
+    role): request framing + keep-alive; dialects (S3 XML, Swift)
+    subclass and implement ``_handle``."""
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0):
+        self._server = await asyncio.start_server(self._conn, host, port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        return host, self.port
+
+    async def stop(self) -> None:
+        if self._server:
+            self._server.close()
+            await self._server.wait_closed()
+
+    async def _handle(self, method: str, target: str, headers: dict,
+                      body: bytes) -> tuple[int, dict, bytes]:
+        raise NotImplementedError
+
+    async def _conn(self, reader: asyncio.StreamReader,
+                    writer: asyncio.StreamWriter) -> None:
+        try:
+            while True:
+                line = await reader.readline()
+                if not line:
+                    return
+                method, target, _ = line.decode().split(" ", 2)
+                headers = {}
+                while True:
+                    h = await reader.readline()
+                    if h in (b"\r\n", b"\n", b""):
+                        break
+                    k, v = h.decode().split(":", 1)
+                    headers[k.strip().lower()] = v.strip()
+                body = b""
+                n = int(headers.get("content-length", "0"))
+                if n:
+                    body = await reader.readexactly(n)
+                status, rheaders, rbody = await self._handle(
+                    method, target, headers, body)
+                reason = {200: "OK", 201: "Created", 202: "Accepted",
+                          204: "No Content", 404: "Not Found",
+                          400: "Bad Request", 401: "Unauthorized",
+                          403: "Forbidden",
+                          409: "Conflict"}.get(status, "Error")
+                head = [f"HTTP/1.1 {status} {reason}"]
+                rheaders.setdefault("content-length", str(len(rbody)))
+                rheaders.setdefault("connection", "keep-alive")
+                for k, v in rheaders.items():
+                    head.append(f"{k}: {v}")
+                payload = b"" if method == "HEAD" else rbody
+                writer.write(("\r\n".join(head) + "\r\n\r\n").encode()
+                             + payload)
+                await writer.drain()
+        except (asyncio.IncompleteReadError, ConnectionError,
+                ValueError):
+            pass
+        finally:
+            writer.close()
+
+
+class S3Frontend(HttpFrontend):
     """Minimal S3 REST dialect over asyncio TCP (rgw_asio_frontend
     role): virtual-path addressing, XML bodies, and AWS sigv4 request
     authentication when a user table is configured (rgw_auth_s3.h:262
@@ -875,65 +962,15 @@ class S3Frontend:
             return "SignatureDoesNotMatch"
         return None
 
-    async def start(self, host: str = "127.0.0.1", port: int = 0):
-        self._server = await asyncio.start_server(self._conn, host, port)
-        self.port = self._server.sockets[0].getsockname()[1]
-        return host, self.port
-
-    async def stop(self) -> None:
-        if self._server:
-            self._server.close()
-            await self._server.wait_closed()
-
-    async def _conn(self, reader: asyncio.StreamReader,
-                    writer: asyncio.StreamWriter) -> None:
-        try:
-            while True:
-                line = await reader.readline()
-                if not line:
-                    return
-                method, target, _ = line.decode().split(" ", 2)
-                headers = {}
-                while True:
-                    h = await reader.readline()
-                    if h in (b"\r\n", b"\n", b""):
-                        break
-                    k, v = h.decode().split(":", 1)
-                    headers[k.strip().lower()] = v.strip()
-                body = b""
-                n = int(headers.get("content-length", "0"))
-                if n:
-                    body = await reader.readexactly(n)
-                if self.users:
-                    err = self._authenticate(method, target, headers,
-                                             body)
-                else:
-                    err = None
-                if err is not None:
-                    el = ET.Element("Error")
-                    ET.SubElement(el, "Code").text = err
-                    status, rheaders, rbody = 403, {
-                        "content-type": "application/xml"}, _xml(el)
-                else:
-                    status, rheaders, rbody = await self._route(
-                        method, target, headers, body
-                    )
-                reason = {200: "OK", 204: "No Content", 404: "Not Found",
-                          400: "Bad Request", 403: "Forbidden",
-                          409: "Conflict"}.get(status, "Error")
-                head = [f"HTTP/1.1 {status} {reason}"]
-                rheaders.setdefault("content-length", str(len(rbody)))
-                rheaders.setdefault("connection", "keep-alive")
-                for k, v in rheaders.items():
-                    head.append(f"{k}: {v}")
-                writer.write(("\r\n".join(head) + "\r\n\r\n").encode()
-                             + rbody)
-                await writer.drain()
-        except (asyncio.IncompleteReadError, ConnectionError,
-                ValueError):
-            pass
-        finally:
-            writer.close()
+    async def _handle(self, method: str, target: str, headers: dict,
+                      body: bytes) -> tuple[int, dict, bytes]:
+        err = (self._authenticate(method, target, headers, body)
+               if self.users else None)
+        if err is not None:
+            el = ET.Element("Error")
+            ET.SubElement(el, "Code").text = err
+            return 403, {"content-type": "application/xml"}, _xml(el)
+        return await self._route(method, target, headers, body)
 
     async def _route(self, method: str, target: str, headers: dict,
                      body: bytes):

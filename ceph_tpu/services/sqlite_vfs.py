@@ -148,6 +148,7 @@ class _StripedHandle:
         self.flags = flags
         self.locked = False
         self.cookie = uuid.uuid4().hex
+        self.renew_task = None
 
 
 def _enc_lock(*fields: str) -> bytes:
@@ -165,7 +166,8 @@ class CephVFS:
     """
 
     def __init__(self, bridge: ClusterLoopThread, client, pool_id: int,
-                 name: str | None = None, layout=None):
+                 name: str | None = None, layout=None,
+                 lock_duration_s: float = 30.0):
         from ..osdc.striped_client import RadosStriper
         from ..osdc.striper import FileLayout
 
@@ -177,6 +179,7 @@ class CephVFS:
             client, pool_id,
             layout or FileLayout(stripe_unit=64 << 10, stripe_count=2,
                                  object_size=1 << 20))
+        self.lock_duration_s = lock_duration_s
         self._files: dict[int, _StripedHandle] = {}
         self._next = 1
         self._registered = False
@@ -190,20 +193,44 @@ class CephVFS:
     def _lock_oid(self, name: str) -> str:
         return name + ".striper.lockobj"
 
+    def _lock_input(self, h: _StripedHandle) -> bytes:
+        return (_enc_lock(_LOCK_NAME, "exclusive",
+                          getattr(self.client, "name", "client"),
+                          h.cookie)
+                + denc.enc_u64(int(self.lock_duration_s * 1000)))
+
     def _acquire(self, h: _StripedHandle) -> int:
+        """Take the per-database exclusive lock WITH a duration
+        (SimpleRADOSStriper's timed biglock role): a holder that dies
+        without unlocking simply expires — re-locking with the same
+        owner+cookie renews, and a background task on the bridge loop
+        keeps renewing while the handle is open."""
         from ..cluster.client import RadosError
 
         try:
             self.bridge.call(self.client.execute(
                 self.pool_id, self._lock_oid(h.name), "lock", "lock",
-                _enc_lock(_LOCK_NAME, "exclusive",
-                          getattr(self.client, "name", "client"),
-                          h.cookie)))
+                self._lock_input(h)))
         except RadosError as e:
-            if e.code == -16:  # EBUSY: another writer holds the DB
+            if e.code == -16:  # EBUSY: a live writer holds the DB
                 return SQLITE_BUSY
             raise
         h.locked = True
+
+        async def renew():
+            try:
+                while True:
+                    await asyncio.sleep(self.lock_duration_s / 3)
+                    await self.client.execute(
+                        self.pool_id, self._lock_oid(h.name),
+                        "lock", "lock", self._lock_input(h))
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                return  # lost the lock/cluster: stop renewing
+
+        h.renew_task = asyncio.run_coroutine_threadsafe(
+            renew(), self.bridge.loop)
         return SQLITE_OK
 
     def _release(self, h: _StripedHandle) -> None:
@@ -211,6 +238,9 @@ class CephVFS:
 
         if not h.locked:
             return
+        if h.renew_task is not None:
+            h.renew_task.cancel()
+            h.renew_task = None
         try:
             self.bridge.call(self.client.execute(
                 self.pool_id, self._lock_oid(h.name), "lock", "unlock",
@@ -218,7 +248,9 @@ class CephVFS:
                           getattr(self.client, "name", "client"),
                           h.cookie)))
         except RadosError:
-            pass  # lock object vanished with the db: nothing to hold
+            # lock object vanished with the db, or the grant already
+            # expired — either way the duration bounds any leak
+            pass
         h.locked = False
 
     # ------------------------------------------------------ io methods
@@ -315,7 +347,12 @@ class CephVFS:
             name = (zname.decode() if zname
                     else f"temp-{uuid.uuid4().hex}")
             h = _StripedHandle(self, name, flags)
-            if (flags & OPEN_MAIN_DB) and (flags & OPEN_READWRITE):
+            if flags & OPEN_MAIN_DB:
+                # EVERY main-db open takes the exclusive lock — readers
+                # included: with no in-band page locking (_x_lock is a
+                # no-op), an unlocked reader could see a writer's torn
+                # page set mid-commit (SimpleRADOSStriper holds its
+                # biglock for read-only opens too)
                 rc = self._acquire(h)
                 if rc != SQLITE_OK:
                     return rc
