@@ -330,7 +330,6 @@ def headline(latency: float) -> dict:
         "host_threads": THREADS,
         "hbm_roofline_frac": round(implied / HBM_BYTES_PER_S, 3),
         "tunnel_latency_ms": round(latency * 1e3, 1),
-        "timing_modes": list(_TIMING_MODES),
         "roundtrip_ms": round(dt * 1e3, 2),
         "encode_ms": round(dt_enc * 1e3, 2),
         "decode_ms": round(dt_dec * 1e3, 2),
@@ -655,6 +654,9 @@ def main() -> None:
     ):
         _progress(f"{name} ...")
         result["configs"][name] = fn(latency)
+    # snapshot AFTER every config ran, so later chains' modes (e.g. a
+    # conservative fallback in config 1/4) are reported too
+    result["timing_modes"] = list(_TIMING_MODES)
     _progress("all configs done")
     print(json.dumps(result))
 

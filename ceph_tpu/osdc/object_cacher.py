@@ -269,6 +269,21 @@ class ObjectCacher:
         else:
             self._drop(self._norm(name))
 
+    def invalidate_clean(self) -> None:
+        """Drop every CLEAN cached byte but keep dirty overlays: the
+        next read re-fetches fresh server content and merges the
+        still-buffered writes over it. This is the right fence after a
+        server-side mutation behind the cache (truncate/rollback):
+        a full invalidate would silently discard acknowledged writes
+        that were buffered while the mutation's awaits were in flight,
+        and no invalidate at all serves doomed bytes."""
+        for oid in [o for o, obj in self._objs.items()
+                    if not obj.dirty]:
+            self._drop(oid)
+        for obj in self._objs.values():
+            obj.fetched = False  # the dirty overlay itself persists
+            obj.absent = False
+
 
 class CacheIo:
     """RadosClient-shaped facade routing per-object data ops through

@@ -809,10 +809,12 @@ class FSClient:
             await self._cacher.flush()
         await self._req("truncate", path=path, size=size)
         if self._cacher is not None:
-            # drop cached content AFTER the MDS applied the cut: an
-            # invalidate taken before it leaves a window where a
-            # concurrent read re-caches pre-truncate bytes as clean
-            self._cacher.invalidate()
+            # drop CLEAN cached content AFTER the MDS applied the cut:
+            # invalidating before it leaves a window where a concurrent
+            # read re-caches pre-truncate bytes, and a full invalidate
+            # here would discard other files' writes buffered during
+            # the RPC await (both round-5 review findings)
+            self._cacher.invalidate_clean()
 
     # ---------------------------------------------------------- snapshots
     #

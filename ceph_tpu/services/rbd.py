@@ -38,7 +38,12 @@ from __future__ import annotations
 import asyncio
 import secrets
 
-from ..osdc.striper import FileLayout, StripedReadResult, file_to_extents
+from ..osdc.striper import (
+    FileLayout,
+    StripedReadResult,
+    extent_to_file,
+    file_to_extents,
+)
 from ..utils import denc
 
 
@@ -59,6 +64,9 @@ ATTR_PARENT = "rbd.parent"  # "name@snap" of the clone source
 LOCK_NAME = "rbd_lock"  # the cls lock name (librbd RBD_LOCK_NAME)
 NOTIFY_REQUEST_LOCK = b"request_lock"
 ATTR_OMAP_BITS = "rbd.objectmap"  # 1 byte/object: 1 = exists
+ATTR_MIGRATING = "rbd.migrating"  # on the SOURCE: "pool/dst" target
+ATTR_MIGRATION_SOURCE = "rbd.migration_source"  # on the DST: "pool/src"
+ATTR_MIGRATION_EXECUTED = "rbd.migration_executed"
 
 
 class LockBusy(Exception):
@@ -192,6 +200,171 @@ class RBD:
             f"{parent}@{snap}".encode(),
         )
 
+    # ------------------------------------------- deep copy + migration
+
+    async def deep_copy(self, src_name: str, dst_name: str,
+                        dst_rbd: "RBD | None" = None,
+                        layout: FileLayout | None = None) -> None:
+        """Full image copy INCLUDING snapshot history, optionally to
+        another pool and/or a new layout (librbd DeepCopyRequest role,
+        src/librbd/DeepCopyRequest.cc): each source snapshot level
+        replays oldest-first into the destination and is re-frozen
+        there, so dst@s matches src@s for every s."""
+        dst_rbd = dst_rbd or self
+        src = await self.open(src_name)
+        try:
+            await dst_rbd.open(dst_name)
+            raise ImageExists(dst_name)
+        except ImageNotFound:
+            pass
+        await dst_rbd.create(dst_name, src.size, layout or src.layout)
+        dst = await dst_rbd.open(dst_name)
+        await dst.acquire_lock()
+        try:
+            await self._replay_levels(src_name, dst)
+        finally:
+            await dst.release_lock()
+
+    async def _replay_levels(self, src_name: str, dst: "Image") -> None:
+        """Replay every source snapshot level then the head into dst
+        (dst's lock must be held). Objects dst ALREADY owns are left
+        alone — for a migration target that means a client write made
+        after prepare wins over history replay (its object's snapshot
+        levels collapse onto the written content; the reference keeps
+        per-snap object states, the lite tier documents the collapse)."""
+        src0 = Image(self.client, self.pool_id, src_name,
+                     allow_migrating=True)
+        await src0.refresh()
+        async def probe(objno: int):
+            try:
+                await self.client.stat(dst.pool_id, dst._oid(objno))
+                return objno
+            except KeyError:
+                return None
+
+        owned = set(
+            o for o in await asyncio.gather(
+                *(probe(i) for i in range(dst._object_count())))
+            if o is not None)
+        prev: dict[int, bytes] = {}
+        levels: list[str | None] = list(src0.snaps) + [None]
+        for snap in levels:
+            src = Image(self.client, self.pool_id, src_name,
+                        snap=snap, allow_migrating=True)
+            await src.refresh()
+            for objno in range(dst._object_count()):
+                if objno in owned:
+                    continue
+                runs = extent_to_file(dst.layout, objno, 0,
+                                      dst.layout.object_size)
+                parts = await asyncio.gather(
+                    *(src.read(fo, fl) for fo, fl in runs))
+                content = b"".join(
+                    p + b"\x00" * (fl - len(p))
+                    for p, (_fo, fl) in zip(parts, runs)
+                ).rstrip(b"\x00")
+                if content == prev.get(objno, b""):
+                    continue  # unchanged at this level: snap shares it
+                await dst._omap_prewrite((objno,))
+                await self.client.write_full(
+                    dst.pool_id, dst._oid(objno), content,
+                    snapc=dst._snapc())
+                dst._omap_settle(objno, True)  # exists (maybe empty)
+                prev[objno] = content
+            if snap is not None:
+                await dst.snap_create(snap)
+
+    async def migration_prepare(self, src_name: str, dst_name: str,
+                                dst_rbd: "RBD | None" = None,
+                                layout: FileLayout | None = None
+                                ) -> None:
+        """Link src -> dst for live migration (librbd migration role,
+        src/librbd/api/Migration.cc): after prepare, clients open the
+        TARGET (the source refuses opens); target reads fall through
+        to the source at byte level (layout may differ), writes
+        copy-up. execute() moves the remaining data + snapshot
+        history in the background; commit() retires the source."""
+        dst_rbd = dst_rbd or self
+        src = await self.open(src_name)
+        try:
+            await dst_rbd.open(dst_name)
+            raise ImageExists(dst_name)
+        except ImageNotFound:
+            pass
+        await dst_rbd.create(dst_name, src.size, layout or src.layout)
+        await dst_rbd.client.setxattr(
+            dst_rbd.pool_id, _header(dst_name), ATTR_MIGRATION_SOURCE,
+            f"{self.pool_id}/{src_name}".encode())
+        await self.client.setxattr(
+            self.pool_id, _header(src_name), ATTR_MIGRATING,
+            f"{dst_rbd.pool_id}/{dst_name}".encode())
+
+    async def migration_execute(self, dst_name: str) -> None:
+        """Copy everything still unowned from the source (snapshot
+        levels first, then head), under the target's exclusive lock."""
+        dst = await self.open(dst_name)
+        if dst._mig_src is None:
+            raise RuntimeError(f"{dst_name} is not a migration target")
+        src = dst._mig_src
+        src_rbd = RBD(self.client, src.pool_id)
+        await dst.acquire_lock()
+        try:
+            await src_rbd._replay_levels(src.name, dst)
+            await self.client.setxattr(
+                self.pool_id, _header(dst_name),
+                ATTR_MIGRATION_EXECUTED, b"1")
+        finally:
+            await dst.release_lock()
+
+    async def migration_commit(self, dst_name: str) -> None:
+        """Retire the source image; the target stands alone."""
+        dst = await self.open(dst_name)
+        if dst._mig_src is None:
+            raise RuntimeError(f"{dst_name} is not a migration target")
+        try:
+            await self.client.getxattr(
+                self.pool_id, _header(dst_name),
+                ATTR_MIGRATION_EXECUTED)
+        except (KeyError, IOError):  # ENODATA: xattr absent
+            raise RuntimeError(
+                f"{dst_name}: migration not executed yet") from None
+        src = dst._mig_src
+        src_rbd = RBD(self.client, src.pool_id)
+        await src_rbd._remove_migrating_source(src.name)
+        await self.client.rmxattr(
+            self.pool_id, _header(dst_name), ATTR_MIGRATION_SOURCE)
+        await self.client.rmxattr(
+            self.pool_id, _header(dst_name), ATTR_MIGRATION_EXECUTED)
+
+    async def migration_abort(self, dst_name: str) -> None:
+        """Tear the target down and give the source back to clients."""
+        dst = await self.open(dst_name)
+        if dst._mig_src is None:
+            raise RuntimeError(f"{dst_name} is not a migration target")
+        src = dst._mig_src
+        await self.client.rmxattr(
+            src.pool_id, _header(src.name), ATTR_MIGRATING)
+        dst._mig_src = None  # keep remove() from re-resolving it
+        for snap in list(dst.snaps):  # replayed levels die with it
+            await dst.snap_remove(snap)
+        await self.remove(dst_name)
+
+    async def _remove_migrating_source(self, name: str) -> None:
+        img = Image(self.client, self.pool_id, name,
+                    allow_migrating=True)
+        await img.refresh()
+        for snap in list(img.snaps):
+            await img.snap_remove(snap)
+        await img.acquire_lock()
+        async with img._io_guard():
+            await img._remove_objects()
+        await img.release_lock()
+        try:
+            await self.client.delete(self.pool_id, _omap_oid(name))
+        except KeyError:
+            pass
+        await self.client.delete(self.pool_id, _header(name))
+
 
 def _enc_layout(lo: FileLayout) -> bytes:
     return (denc.enc_u64(lo.stripe_unit) + denc.enc_u64(lo.stripe_count)
@@ -210,10 +383,15 @@ class Image:
 
     def __init__(self, client, pool_id: int, name: str,
                  snap: str | None = None, exclusive: bool = True,
-                 cache: bool = False):
+                 cache: bool = False, allow_migrating: bool = False):
         self.client = client
         self.pool_id = pool_id
         self.name = name
+        #: internal opens during migration bypass the mid-migration
+        #: guard (clients must open the TARGET, librbd migration role)
+        self._allow_migrating = allow_migrating
+        #: source Image handle while THIS image is a migration target
+        self._mig_src: "Image | None" = None
         #: optional write-back/read-ahead data cache (ObjectCacher
         #: role); only served while the exclusive lock is OWNED (cached
         #: reads acquire it, librbd's exclusive-lock+cache behavior),
@@ -546,6 +724,19 @@ class Image:
             )
         except KeyError:
             raise ImageNotFound(self.name) from None
+        if attrs.get(ATTR_MIGRATING) and not self._allow_migrating:
+            raise RuntimeError(
+                f"image {self.name} is mid-migration; open the target "
+                f"{attrs[ATTR_MIGRATING].decode()!r}")
+        raw_src = attrs.get(ATTR_MIGRATION_SOURCE)
+        if raw_src and self._mig_src is None:
+            spool, sname = raw_src.decode().split("/", 1)
+            src = Image(self.client, int(spool), sname,
+                        allow_migrating=True)
+            await src.refresh()
+            self._mig_src = src
+        elif not raw_src:
+            self._mig_src = None
         self.size = denc.dec_u64(attrs[ATTR_SIZE], 0)[0]
         self.layout = _dec_layout(attrs[ATTR_LAYOUT])
         pairs = _dec_snaps(attrs[ATTR_SNAPS])
@@ -609,10 +800,12 @@ class Image:
                 except KeyError:
                     pass
             if self._cacher is not None:
-                # objects are cut: NOW drop the cache (invalidate
-                # before the cut would let a concurrent read re-cache
-                # doomed bytes as clean — librbd's ordering)
-                self._cacher.invalidate()
+                # objects are cut: NOW drop clean cache content
+                # (before the cut, a concurrent read could re-cache
+                # doomed bytes; a FULL invalidate here would discard
+                # writes buffered during the cut's awaits — clean-only
+                # keeps those overlays)
+                self._cacher.invalidate_clean()
         await self.client.setxattr(
             self.pool_id, _header(self.name), ATTR_SIZE,
             denc.enc_u64(new_size),
@@ -665,7 +858,7 @@ class Image:
         """Clone COW: first write to an object absent in the child
         copies the parent's data (read at the parent's RADOS snap id)
         up into the child (librbd CopyupRequest role)."""
-        if self.parent is None:
+        if self.parent is None and self._mig_src is None:
             return
         if (self._omap is not None and objectno < len(self._omap)
                 and self._omap[objectno] == 1):
@@ -678,13 +871,20 @@ class Image:
             return  # child already owns this object
         except KeyError:
             pass
-        pname, _psnap = self.parent
-        src = _data_fmt(pname).format(objectno=objectno).encode()
-        try:
-            blob = await self.client.read(self.pool_id, src,
-                                          snapid=self._parent_snapid)
-        except KeyError:
-            return  # parent hole: child object starts empty
+        if self.parent is not None:
+            pname, _psnap = self.parent
+            src = _data_fmt(pname).format(objectno=objectno).encode()
+            try:
+                blob = await self.client.read(
+                    self.pool_id, src, snapid=self._parent_snapid)
+            except KeyError:
+                return  # parent hole: child object starts empty
+        else:  # migration target: pull the object's bytes from the
+            #    source image through ITS layout
+            blob = await self._read_from_source(
+                objectno, 0, self.layout.object_size)
+            if not blob:
+                return  # source hole
         await self._omap_prewrite((objectno,))
         await self._io.write_full(
             self.pool_id, self._oid(objectno), blob,
@@ -734,7 +934,24 @@ class Image:
                 )
             except KeyError:
                 pass
+        if self._mig_src is not None:
+            # migration fallthrough at BYTE level: the target may use
+            # a different layout/pool than the source, so the absent
+            # object's range maps back to file offsets and reads
+            # through the source image's own striping
+            return await self._read_from_source(ex.objectno, ex.offset,
+                                                ex.length)
         return b""  # hole
+
+    async def _read_from_source(self, objectno: int, off: int,
+                                length: int) -> bytes:
+        runs = extent_to_file(self.layout, objectno, off, length)
+        parts = await asyncio.gather(
+            *(self._mig_src.read(fo, fl) for fo, fl in runs))
+        return b"".join(
+            p + b"\x00" * (fl - len(p))
+            for p, (_fo, fl) in zip(parts, runs)
+        ).rstrip(b"\x00")
 
     async def discard(self, offset: int, length: int) -> None:
         """Zero a byte range (librbd discard role; object-interior
@@ -852,7 +1069,7 @@ class Image:
         await asyncio.gather(
             *(rb(i) for i in range(self._object_count())))
         if self._cacher is not None:
-            self._cacher.invalidate()  # see flush note above
+            self._cacher.invalidate_clean()  # see flush note above
 
     async def snap_list(self) -> list[str]:
         await self.refresh()

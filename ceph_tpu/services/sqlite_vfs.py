@@ -240,6 +240,13 @@ class CephVFS:
             return
         if h.renew_task is not None:
             h.renew_task.cancel()
+            try:
+                # WAIT for an in-flight renewal to settle before the
+                # unlock: a renewal landing after it would re-grant the
+                # lock to this dead cookie for a full duration
+                h.renew_task.result(timeout=10)
+            except Exception:
+                pass
             h.renew_task = None
         try:
             self.bridge.call(self.client.execute(

@@ -333,3 +333,117 @@ def test_cache_coherent_across_rollback_and_shrink():
         await c.stop()
 
     run(t())
+
+
+def test_deep_copy_with_snapshot_history():
+    """deep_copy replays every snapshot level: dst@s == src@s for all
+    s, head matches, and a new layout is honored (DeepCopyRequest
+    role)."""
+    async def t():
+        c, rbd = await make()
+        await rbd.create("src", 4 * 8192, LAYOUT)
+        img = await rbd.open("src")
+        await img.write(0, b"v1" * 4096)
+        await img.snap_create("s1")
+        await img.write(8192, b"v2" * 4096)
+        await img.snap_create("s2")
+        await img.write(0, b"v3" * 4096)
+        await img.release_lock()
+
+        new_layout = FileLayout(stripe_unit=4096, stripe_count=2,
+                                object_size=16384)
+        await rbd.deep_copy("src", "dst", layout=new_layout)
+        dst = await rbd.open("dst")
+        assert dst.snaps == ["s1", "s2"]
+        assert dst.layout.object_size == 16384
+        assert await dst.read(0, 8192) == b"v3" * 4096
+        assert await dst.read(8192, 8192) == b"v2" * 4096
+        for s, want0, want1 in [("s1", b"v1" * 4096, b"\x00" * 8192),
+                                ("s2", b"v1" * 4096, b"v2" * 4096)]:
+            view = await rbd.open("dst", snap=s)
+            assert await view.read(0, 8192) == want0
+            assert await view.read(8192, 8192) == want1
+        # the copy is independent of the source
+        img2 = await rbd.open("src")
+        await img2.write(0, b"XX")
+        assert (await dst.read(0, 2)) == b"v3"
+        await c.stop()
+
+    run(t())
+
+
+def test_migration_lifecycle():
+    """prepare -> target serves reads/writes with source fallback ->
+    execute moves data+snaps -> commit retires the source
+    (librbd api/Migration.cc role)."""
+    async def t():
+        c, rbd = await make()
+        await rbd.create("old", 4 * 8192, LAYOUT)
+        img = await rbd.open("old")
+        await img.write(0, b"A" * 8192)
+        await img.snap_create("s")
+        await img.write(8192, b"B" * 8192)
+        await img.release_lock()
+
+        new_layout = FileLayout(stripe_unit=8192, stripe_count=1,
+                                object_size=16384)
+        await rbd.migration_prepare("old", "new", layout=new_layout)
+        # the source refuses normal opens now
+        with pytest.raises(RuntimeError, match="mid-migration"):
+            await rbd.open("old")
+        # the target serves the source's data before any copy happened
+        dst = await rbd.open("new")
+        assert await dst.read(0, 8192) == b"A" * 8192
+        assert await dst.read(8192, 8192) == b"B" * 8192
+        # a write to the target copies up and sticks (into dst object
+        # 1 = bytes [16384, 32768), a source hole — so dst object 0
+        # stays unowned and its snapshot history replays properly)
+        await dst.write(16384 + 100, b"LIVE")
+        assert (await dst.read(16384 + 96, 12)
+                ) == b"\x00" * 4 + b"LIVE" + b"\x00" * 4
+        # commit before execute is refused
+        with pytest.raises(RuntimeError, match="not executed"):
+            await rbd.migration_commit("new")
+        await rbd.migration_execute("new")
+        await rbd.migration_commit("new")
+        # source is gone; target stands alone with the snap history
+        with pytest.raises(ImageNotFound):
+            await rbd.open("old")
+        dst2 = await rbd.open("new")
+        assert dst2.snaps == ["s"]
+        assert await dst2.read(0, 8192) == b"A" * 8192
+        assert await dst2.read(8192, 8192) == b"B" * 8192
+        got = await dst2.read(16384 + 96, 12)
+        assert got == b"\x00" * 4 + b"LIVE" + b"\x00" * 4
+        snap_view = await rbd.open("new", snap="s")
+        # object 0 was never client-written: its history replayed
+        # properly — at snap s the B range did not exist yet
+        assert await snap_view.read(0, 8192) == b"A" * 8192
+        assert await snap_view.read(8192, 8192) == b"\x00" * 8192
+        # object 1 was client-written post-prepare: its history
+        # collapses onto the written content (documented lite
+        # semantics)
+        assert (await snap_view.read(16384 + 100, 4)) == b"LIVE"
+        await c.stop()
+
+    run(t())
+
+
+def test_migration_abort_restores_source():
+    async def t():
+        c, rbd = await make()
+        await rbd.create("keep", 2 * 8192, LAYOUT)
+        img = await rbd.open("keep")
+        await img.write(0, b"K" * 100)
+        await img.release_lock()
+        await rbd.migration_prepare("keep", "scrapped")
+        dst = await rbd.open("scrapped")
+        await dst.write(0, b"doomed")
+        await rbd.migration_abort("scrapped")
+        with pytest.raises(ImageNotFound):
+            await rbd.open("scrapped")
+        img2 = await rbd.open("keep")  # source serves again, untouched
+        assert await img2.read(0, 100) == b"K" * 100
+        await c.stop()
+
+    run(t())
