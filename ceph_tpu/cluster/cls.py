@@ -440,3 +440,59 @@ def rgw_index_list(ctx: ClsContext, inp: bytes) -> bytes:
 def rgw_bucket_stats(ctx: ClsContext, inp: bytes) -> bytes:
     count, nbytes, gen = _rgw_stats(ctx)
     return denc.enc_u64(count) + denc.enc_u64(nbytes) + denc.enc_u64(gen)
+
+
+# ============================================ built-in: rgw datalog
+#
+# The cls_log/rgw_datalog role (src/cls/log/, src/rgw/driver/rados/
+# rgw_datalog.cc): an append-only change log whose sequence counter
+# lives in the log object's omap header, so allocation of the next seq
+# and the entry write commit atomically — concurrent writers can never
+# mint the same seq. Entries are opaque to this class; keys are
+# 16-hex-digit seqs so omap order IS log order.
+
+
+def _datalog_head(ctx: ClsContext) -> int:
+    hdr = ctx.omap_get_header()
+    return denc.dec_u64(hdr, 0)[0] if len(hdr) >= 8 else 0
+
+
+@register("rgw", "datalog_add", RD | WR)
+def rgw_datalog_add(ctx: ClsContext, inp: bytes) -> bytes:
+    seq = _datalog_head(ctx)
+    ctx.omap_set(f"{seq:016x}".encode(), inp)
+    ctx.omap_set_header(denc.enc_u64(seq + 1))
+    return denc.enc_u64(seq)
+
+
+@register("rgw", "datalog_list", RD)
+def rgw_datalog_list(ctx: ClsContext, inp: bytes) -> bytes:
+    """Input (from_seq u64, max u32) -> u64 head (next seq to be
+    minted), enc_u32 n, n x (u64 seq, enc_bytes entry), u8 truncated.
+    ``head`` lets a syncer snapshot "where the log ends NOW" before a
+    full sync, closing the bootstrap gap."""
+    from_seq, off = denc.dec_u64(inp, 0)
+    maxn, _ = denc.dec_u32(inp, off)
+    lo = f"{from_seq:016x}".encode()
+    keys = [k for k in ctx.omap_keys() if k >= lo]
+    page = keys[:maxn]
+    out = [denc.enc_u64(_datalog_head(ctx)), denc.enc_u32(len(page))]
+    for k in page:
+        out.append(denc.enc_u64(int(k, 16)))
+        out.append(denc.enc_bytes(ctx.omap_get(k)))
+    out.append(denc.enc_u8(1 if len(keys) > maxn else 0))
+    return b"".join(out)
+
+
+@register("rgw", "datalog_trim", RD | WR)
+def rgw_datalog_trim(ctx: ClsContext, inp: bytes) -> bytes:
+    """Drop entries with seq < upto (applied history; the head counter
+    never rewinds)."""
+    upto, _ = denc.dec_u64(inp, 0)
+    hi = f"{upto:016x}".encode()
+    for k in ctx.omap_keys():
+        if k < hi:
+            ctx.omap_rm(k)
+        else:
+            break
+    return b""

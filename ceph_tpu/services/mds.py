@@ -27,6 +27,18 @@ metadata ops. This module promotes it to the reference's shape:
 
 File DATA is striped client-side exactly as before (fsdata.<ino> via
 the osdc striper); only metadata flows through the daemon.
+
+**Multi-MDS (round 5).** Several ranks (``mds.0``, ``mds.1``, …)
+partition the namespace by SUBTREE (the MDSMap subtree + MDBalancer
+role): a durable RADOS table maps directory prefixes to ranks; each
+rank serves only paths it owns and redirects the rest (ESTALE +
+subtree map, the Server.cc forward role). Because dirfrags live in
+shared RADOS omaps rather than per-MDS caches, exporting a subtree is
+an authority HANDOVER — recall caps, flip one omap row — not the
+reference's two-phase cache migration. Cross-subtree renames route
+their link half through the destination authority as a peer request
+(the slave-request role), and ``MDBalancer`` moves hot top-level
+directories between ranks on decaying load counters.
 """
 from __future__ import annotations
 
@@ -48,6 +60,29 @@ SEQ_BASE_KEY = b"seq_base"
 JOURNAL_OID = b"mdslog"
 JOURNAL_TRIM_BYTES = 1 << 20
 SNAP_TABLE_OID = b"fsmeta.snaps"  # SnapServer table role
+#: durable subtree-authority table (the MDSMap subtree/export_pin
+#: role): omap path -> u32 rank. Rank 0 owns "/" implicitly. Because
+#: every dirfrag lives in shared RADOS omaps — not in per-MDS caches —
+#: "exporting" a subtree is an AUTHORITY handover (flip the row, recall
+#: caps), not the reference's two-phase metadata migration
+#: (src/mds/Migrator.cc): the heavyweight state transfer is designed
+#: out by the storage model.
+SUBTREE_OID = b"fsmeta.subtrees"
+
+
+def _norm(path: str) -> str:
+    return "/" + "/".join(x for x in path.split("/") if x)
+
+
+def _deepest_rank(submap: dict[str, int], path: str) -> int:
+    """Deepest subtree prefix owning ``path`` (MDSMap subtree
+    resolution role) — shared by daemon and client."""
+    p = _norm(path)
+    best, rank = -1, 0
+    for sub, r in submap.items():
+        if _under(p, sub) and len(sub) > best:
+            best, rank = len(sub), r
+    return rank
 
 
 def _snap_dir_oid(snapid: int, ino: int) -> bytes:
@@ -88,10 +123,25 @@ class MDSLite:
                  data_pool: int | None = None):
         self.bus = bus
         self.name = name
+        try:
+            self.rank = int(name.rsplit(".", 1)[1])
+        except (IndexError, ValueError):
+            self.rank = 0
+        #: path -> owning rank; "/" is rank 0 unless exported
+        self.subtrees: dict[str, int] = {"/": 0}
+        #: decaying per-top-level-dir request counters (MDBalancer
+        #: load model role)
+        self.load: dict[str, float] = {}
+        self._peer_tid = 0
+        self._peer_futs: dict[int, asyncio.Future] = {}
         self.fs = fslib.FSLite(client, pool_id, data_pool=data_pool)
         self.fs.snapc_cb = self._snapc
         self.client = client
         self.meta_pool = pool_id
+        #: per-rank journal: ranks journal independently (one MDLog
+        #: per rank, like the reference's per-rank journals)
+        self.journal_oid = (JOURNAL_OID if self.rank == 0
+                            else b"%s.%d" % (JOURNAL_OID, self.rank))
         #: where file DATA lives (snap ids are allocated against it)
         self.data_pool = pool_id if data_pool is None else data_pool
         self.revoke_timeout = revoke_timeout
@@ -116,7 +166,88 @@ class MDSLite:
     async def start(self) -> None:
         self.bus.register(self.name, self.handle)
         await self._load_snap_table()
+        await self._load_subtrees()
         await self._replay_journal()
+
+    # --------------------------------------------------- subtree authority
+
+    async def _load_subtrees(self) -> None:
+        subtrees = {"/": 0}
+        try:
+            omap = await self.client.omap_get(self.meta_pool,
+                                              SUBTREE_OID)
+        except KeyError:
+            omap = {}
+        for k, v in omap.items():
+            subtrees[k.decode()] = denc.dec_u32(v, 0)[0]
+        self.subtrees = subtrees
+
+    def auth_rank(self, path: str) -> int:
+        return _deepest_rank(self.subtrees, path)
+
+    def _enc_submap(self) -> bytes:
+        return denc.enc_map(
+            {k.encode(): denc.enc_u32(v)
+             for k, v in self.subtrees.items()},
+            denc.enc_bytes, denc.enc_bytes)
+
+    async def export_dir(self, path: str, target: int) -> None:
+        """Hand authority for directory ``path`` to ``target`` rank
+        (the Migrator::export_dir role, reduced to cap recall + a
+        durable map flip — see SUBTREE_OID note)."""
+        p = _norm(path)
+        if p == "/":
+            raise fslib.FSError("cannot export the root")
+        async with self._lock:
+            if self.auth_rank(p) != self.rank:
+                raise fslib.FSError(f"{p} not ours to export")
+            ent = await self.fs.stat(p)
+            if ent["type"] != fslib.T_DIR:
+                raise fslib.FSError(f"{p} is not a directory")
+            # recall every write cap under the subtree (all ranks):
+            # buffered sizes must land in dentries the new authority
+            # will read
+            await self._recall_subtree(p)
+            args = {"path": p.encode(), "rank": denc.enc_u32(target)}
+            seq = await self._journal("export", args)
+            await self._apply_export(p, target)
+            await self._expire(seq)
+
+    async def _apply_export(self, path: str, target: int) -> None:
+        await self.client.omap_set(
+            self.meta_pool, SUBTREE_OID,
+            {path.encode(): denc.enc_u32(target)})
+        self.subtrees[path] = target
+
+    # ------------------------------------------------------- peer requests
+
+    async def _peer_req(self, rank: int, verb: str,
+                        args: dict[str, bytes]) -> dict[str, bytes]:
+        """Ask another rank to mutate a dirfrag IT owns (the
+        Server.cc peer/slave-request role): the remote executes under
+        its own mutation lock, so cross-subtree renames serialize
+        against the destination authority's local ops."""
+        self._peer_tid += 1
+        tid = self._peer_tid
+        fut = asyncio.get_running_loop().create_future()
+        self._peer_futs[tid] = fut
+        base = self.name.rsplit(".", 1)[0]
+        try:
+            await self.bus.send(
+                self.name, f"{base}.{rank}",
+                M.MClientRequest(tid=tid, verb=verb, args=args))
+            try:
+                reply = await asyncio.wait_for(fut,
+                                               self.revoke_timeout * 4)
+            except asyncio.TimeoutError:
+                raise fslib.FSError(f"peer {verb} timeout") from None
+        finally:
+            self._peer_futs.pop(tid, None)
+        if reply.result != 0:
+            if reply.result == -17:
+                raise fslib.Exists(verb)
+            raise fslib.FSError(f"peer {verb} failed: {reply.result}")
+        return reply.out
 
     async def _load_snap_table(self) -> None:
         try:
@@ -146,14 +277,14 @@ class MDSLite:
         """Append an intent record (EMetaBlob role) BEFORE mutating."""
         self._seq += 1
         rec = _enc_entry(self._seq, verb, args)
-        await self.client.append(self.meta_pool, JOURNAL_OID, rec)
+        await self.client.append(self.meta_pool, self.journal_oid, rec)
         self._jbytes += len(rec)
         return self._seq
 
     async def _expire(self, seq: int) -> None:
         """All entries <= seq are fully applied (MDLog expire role)."""
         await self.client.omap_set(
-            self.meta_pool, JOURNAL_OID,
+            self.meta_pool, self.journal_oid,
             {EXPIRE_KEY: denc.enc_u64(seq)})
         if self._jbytes > JOURNAL_TRIM_BYTES:
             # opportunistic trim: everything up to self._seq is expired
@@ -166,19 +297,19 @@ class MDSLite:
         of the truncation leaves a journal whose replay allocates fresh
         seqs strictly above expired_upto."""
         await self.client.omap_set(
-            self.meta_pool, JOURNAL_OID,
+            self.meta_pool, self.journal_oid,
             {SEQ_BASE_KEY: denc.enc_u64(self._seq)})
-        await self.client.write_full(self.meta_pool, JOURNAL_OID, b"")
+        await self.client.write_full(self.meta_pool, self.journal_oid, b"")
         self._jbytes = 0
 
     async def _replay_journal(self) -> None:
         """Crash recovery: re-execute unexpired intents idempotently."""
         try:
-            raw = await self.client.read(self.meta_pool, JOURNAL_OID)
+            raw = await self.client.read(self.meta_pool, self.journal_oid)
         except KeyError:
             return
         try:
-            omap = await self.client.omap_get(self.meta_pool, JOURNAL_OID)
+            omap = await self.client.omap_get(self.meta_pool, self.journal_oid)
             expired = denc.dec_u64(omap.get(EXPIRE_KEY,
                                             denc.enc_u64(0)), 0)[0]
             self._seq = denc.dec_u64(omap.get(SEQ_BASE_KEY,
@@ -259,6 +390,12 @@ class MDSLite:
             if fut is not None and not fut.done():
                 fut.set_result(msg)
             return
+        if isinstance(msg, M.MClientReply):
+            # a peer rank answering one of OUR peer requests
+            fut = self._peer_futs.get(msg.tid)
+            if fut is not None and not fut.done():
+                fut.set_result(msg)
+            return
         if not isinstance(msg, M.MClientRequest):
             return
         try:
@@ -271,6 +408,13 @@ class MDSLite:
             out["__snapc"] = denc.enc_u64(seq) + denc.enc_list(
                 ids, denc.enc_u64)
             reply = M.MClientReply(tid=msg.tid, result=0, out=out)
+        except _Redirect as r:
+            # not our subtree: tell the client who owns it (the
+            # forward/ESTALE dance of Server.cc handle_client_request)
+            reply = M.MClientReply(
+                tid=msg.tid, result=M.ESTALE,
+                out={"submap": self._enc_submap(),
+                     "rank": denc.enc_u32(r.rank)})
         except fslib.NoEnt:
             reply = M.MClientReply(tid=msg.tid, result=M.ENOENT, out={})
         except fslib.Exists:
@@ -289,6 +433,39 @@ class MDSLite:
     async def _serve(self, src: str, verb: str,
                      args: dict[str, bytes]) -> dict[str, bytes]:
         path = args.get("path", b"").decode()
+        if verb == "getsubmap":
+            await self._load_subtrees()
+            return {"submap": self._enc_submap()}
+        if verb in ("peer_link", "peer_unlink", "peer_recall"):
+            base = self.name.rsplit(".", 1)[0] + "."
+            if not src.startswith(base):
+                raise fslib.FSError(f"peer op from non-MDS {src!r}")
+            if verb == "peer_recall":
+                # lock-FREE on purpose: the requester may hold its own
+                # mutation lock (mksnap/rename recall) — taking ours
+                # here would recreate the ABBA cycle
+                p = args["path"].decode()
+                for ino, op in list(self._open_paths.items()):
+                    if _under(op, p):
+                        await self._revoke_conflicting(ino, "__peer",
+                                                       "w")
+                return {}
+            async with self._lock:
+                return await self._serve_peer(verb, args)
+        if "path" in args:
+            # subtree authority gate: serve only what we own; refresh
+            # once on a miss (a just-imported subtree reaches us before
+            # any map push), then redirect the client
+            r = self.auth_rank(path)
+            if r != self.rank:
+                await self._load_subtrees()
+                r = self.auth_rank(path)
+            if r != self.rank:
+                raise _Redirect(r)
+            parts = [x for x in path.split("/") if x]
+            if parts:  # decaying per-top-dir load (MDBalancer model)
+                top = "/" + parts[0]
+                self.load[top] = self.load.get(top, 0.0) + 1.0
         if verb in ("stat", "lookup"):
             ent = await self.fs.stat(path)
             if ent["type"] == fslib.T_FILE:
@@ -338,8 +515,15 @@ class MDSLite:
         if verb in ("snapstat", "snaplist"):
             return await self._serve_snap_read(verb, args, path)
         # -------- journaled mutations (single-flight via the lock)
-        async with self._lock:
-            return await self._serve_mutation(src, verb, args, path)
+        try:
+            async with self._lock:
+                return await self._serve_mutation(src, verb, args,
+                                                  path)
+        except _CrossRename as xr:
+            # executed OUTSIDE our mutation lock: awaiting the peer
+            # while holding it would ABBA-deadlock with a simultaneous
+            # opposite-direction rename (round-5 review finding)
+            return await self._cross_rename(xr, args, path)
 
     async def _serve_snap_read(self, verb, args, path):
         """Resolve ``rel`` inside snapshot ``snap`` of dir ``path``
@@ -381,6 +565,133 @@ class MDSLite:
         out["snapid"] = denc.enc_u64(sid)
         return out
 
+    async def _serve_peer(self, verb, args):
+        """Execute a dirfrag mutation on behalf of another rank, under
+        OUR mutation lock — cross-subtree renames serialize against
+        this authority's local ops (Server.cc peer-request role)."""
+        dir_ino = denc.dec_u64(args["dir"], 0)[0]
+        name = args["name"].decode()
+        if verb == "peer_link":
+            if await self.fs._exists(dir_ino, name):
+                raise fslib.Exists(name)
+            await self.client.omap_set(
+                self.meta_pool, fslib._dir_oid(dir_ino),
+                {name.encode(): args["ent"]})
+            return {}
+        # peer_unlink: remove only if the dentry still points at the
+        # expected ino — an undo must never take out a dentry someone
+        # else linked meanwhile
+        want = denc.dec_u64(args["ino"], 0)[0] if "ino" in args else None
+        if want is not None:
+            try:
+                cur = await self.fs._dentry(dir_ino, name)
+            except fslib.NoEnt:
+                return {}
+            if cur["ino"] != want:
+                return {}
+        await self.client.omap_rm(
+            self.meta_pool, fslib._dir_oid(dir_ino), [name.encode()])
+        return {}
+
+    async def _rename_recall(self, path: str, ent: dict) -> None:
+        """Rename flushes sizes and (for directories) drops every cap
+        under the moving subtree: descendant paths change, and after a
+        cross-subtree move a DIFFERENT rank answers for them — a
+        surviving cap would let two clients hold exclusive writes
+        (round-5 review finding)."""
+        if ent["type"] == fslib.T_FILE:
+            await self._revoke_conflicting(ent["ino"], "__rename", "w")
+            return
+        await self._recall_subtree(path)
+
+    async def _recall_subtree(self, path: str) -> None:
+        """Recall every write cap under ``path`` on EVERY rank: nested
+        exports mean other ranks may have granted caps inside our
+        subtree (round-5 review finding). Peer recalls are served
+        lock-free on the remote, so a simultaneous opposite-direction
+        recall cannot deadlock."""
+        for ino, p in list(self._open_paths.items()):
+            if _under(p, path):
+                await self._revoke_conflicting(ino, "__recall", "w")
+        for r in {r for r in self.subtrees.values() if r != self.rank}:
+            try:
+                await self._peer_req(r, "peer_recall",
+                                     {"path": _norm(path).encode()})
+            except fslib.FSError:
+                pass  # peer down: its caps die with it (eviction role)
+
+    def _rename_open_paths(self, path: str, dst: str) -> None:
+        """Rewrite recorded open paths (exact match AND descendants)
+        so later cap flushes find the moved dentries."""
+        np, nd = _norm(path), _norm(dst)
+        for ino, p in list(self._open_paths.items()):
+            pp = _norm(p)
+            if pp == np:
+                self._open_paths[ino] = nd
+            elif _under(pp, np):
+                self._open_paths[ino] = nd + pp[len(np):]
+
+    async def _cross_rename(self, xr: "_CrossRename", args, path):
+        """Cross-subtree rename (Server.cc master/peer arc): journal
+        under our lock, ship the LINK half to the destination authority
+        with our lock RELEASED, then unlink the source under our lock.
+        On a peer failure the link is undone (ino-guarded) or, if the
+        peer is unreachable for the undo too, completed directly — the
+        journal entry never stays half-applied behind the expire
+        watermark."""
+        import time as _t
+
+        dst = args["dst"].decode()
+        async with self._lock:
+            # REVALIDATE under the re-acquired lock: a concurrent
+            # unlink/rename may have won it since _serve_mutation's
+            # checks — journaling a stale intent would resurrect a
+            # deleted file at the destination (round-5 review finding)
+            try:
+                cur = await self.fs._dentry(xr.sp, xr.sn)
+            except fslib.NoEnt:
+                raise fslib.NoEnt(path) from None
+            if cur["ino"] != xr.ent["ino"]:
+                raise fslib.NoEnt(path)
+            xr.ent = cur  # freshest size rides the link
+            seq = await self._journal("rename", args)
+        enc_ent = fslib._enc_inode(xr.ent["ino"], xr.ent["type"],
+                                   xr.ent["size"], _t.time())
+        link = {"dir": denc.enc_u64(xr.dp), "name": xr.dn.encode(),
+                "ent": enc_ent}
+        try:
+            await self._peer_req(xr.rank, "peer_link", link)
+        except fslib.Exists:
+            async with self._lock:
+                await self._expire(seq)
+            raise
+        except fslib.FSError:
+            # undo (the reply may merely have been lost); if even the
+            # undo fails, complete directly — the peer is presumed
+            # down and replay would do the same (rejoin case)
+            try:
+                await self._peer_req(
+                    xr.rank, "peer_unlink",
+                    {"dir": denc.enc_u64(xr.dp),
+                     "name": xr.dn.encode(),
+                     "ino": denc.enc_u64(xr.ent["ino"])})
+            except fslib.FSError:
+                async with self._lock:
+                    await self._apply_rename(path, dst)
+                    await self._expire(seq)
+                    self._rename_open_paths(path, dst)
+                return {}
+            async with self._lock:
+                await self._expire(seq)
+            raise fslib.FSError(f"peer rename {path} -> {dst} failed")
+        async with self._lock:
+            await self.client.omap_rm(
+                self.meta_pool, fslib._dir_oid(xr.sp),
+                [xr.sn.encode()])
+            await self._expire(seq)
+            self._rename_open_paths(path, dst)
+        return {}
+
     async def _serve_mutation(self, src, verb, args, path):
         if verb == "create":
             ent = None
@@ -402,27 +713,30 @@ class MDSLite:
             sp, sn = await self.fs._resolve(path)
             dp, dn = await self.fs._resolve(dst)
             ent = await self.fs._dentry(sp, sn)
+            await self._rename_recall(path, ent)
+            ent = await self.fs._dentry(sp, sn)  # size after flush
             if await self.fs._exists(dp, dn):
                 raise fslib.Exists(dst)
+            dst_parent = _norm(dst).rsplit("/", 1)[0] or "/"
+            dr = self.auth_rank(dst_parent)
+            if dr != self.rank:
+                raise _CrossRename(dr, sp, sn, dp, dn, ent)
             seq = await self._journal(verb, args)
             await self._apply_rename(path, dst,
                                      crash=self._crash_mid_rename)
             await self._expire(seq)
-            for ino, p in list(self._open_paths.items()):
-                if p == path:  # cap flushes must follow the rename
-                    self._open_paths[ino] = dst
+            self._rename_open_paths(path, dst)
             return {}
         if verb == "mksnap":
             name = args["name"].decode()
             dir_ino = await self.fs._walk(self.fs._split(path))
             if (dir_ino, name) in self.snaps:
                 raise fslib.Exists(f"{path}/.snap/{name}")
-            # recall every write cap under the subtree FIRST: buffered
-            # sizes must be in the dentries the snapshot freezes
-            # (the reference recalls caps when a snaprealm changes)
-            for ino, p in list(self._open_paths.items()):
-                if _under(p, path):
-                    await self._revoke_conflicting(ino, "__snap", "w")
+            # recall every write cap under the subtree FIRST — on every
+            # rank, nested exports included: buffered sizes must be in
+            # the dentries the snapshot freezes (the reference recalls
+            # caps when a snaprealm changes)
+            await self._recall_subtree(path)
             sid = await self.client.selfmanaged_snap_create(
                 self.data_pool)
             args = dict(args)
@@ -561,6 +875,10 @@ class MDSLite:
             root = denc.dec_u64(args["root"], 0)[0]
             await self._apply_rmsnap(root, args["name"].decode(), sid)
             return {}
+        if verb == "export":
+            await self._apply_export(args["path"].decode(),
+                                     denc.dec_u32(args["rank"], 0)[0])
+            return {}
         raise fslib.FSError(f"verb {verb!r}")
 
     async def _apply_rename(self, src_path: str, dst_path: str,
@@ -601,6 +919,75 @@ class _MDSCrash(Exception):
     pass
 
 
+class _Redirect(Exception):
+    """Raised by _serve when the path belongs to another rank."""
+
+    def __init__(self, rank: int):
+        super().__init__(f"rank {rank}")
+        self.rank = rank
+
+
+class _CrossRename(Exception):
+    """Control-flow carrier: a validated rename whose destination
+    dirfrag another rank owns; completed by _cross_rename OUTSIDE the
+    mutation lock (see the deadlock note there)."""
+
+    def __init__(self, rank: int, sp: int, sn: str, dp: int, dn: str,
+                 ent: dict):
+        super().__init__(f"cross-rename to rank {rank}")
+        self.rank, self.sp, self.sn = rank, sp, sn
+        self.dp, self.dn, self.ent = dp, dn, ent
+
+
+class MDBalancer:
+    """The MDBalancer.cc role over MDSLite ranks: compare decaying
+    per-rank request loads each tick; when one rank is ``ratio``x
+    hotter than the coolest, export its hottest owned top-level
+    directory there. Works on authority handover (export_dir), so a
+    "migration" costs one omap row + cap recalls, not a cache
+    transfer."""
+
+    def __init__(self, mdss, ratio: float = 2.0,
+                 min_load: float = 8.0):
+        self.mdss = {m.rank: m for m in mdss}
+        self.ratio = ratio
+        self.min_load = min_load
+
+    async def tick(self) -> list[tuple[str, int, int]]:
+        """Returns the moves performed: (path, from_rank, to_rank)."""
+        totals = {r: sum(m.load.values())
+                  for r, m in self.mdss.items()}
+        busy = max(totals, key=lambda r: totals[r])
+        idle = min(totals, key=lambda r: totals[r])
+        moves: list[tuple[str, int, int]] = []
+        if (busy != idle and totals[busy] >= self.min_load
+                and totals[busy] > self.ratio * max(totals[idle], 1.0)):
+            m = self.mdss[busy]
+            for _l, d in sorted(
+                    ((l, d) for d, l in m.load.items()
+                     if d != "/" and m.auth_rank(d) == m.rank),
+                    reverse=True):
+                try:
+                    ent = await m.fs.stat(d)
+                except fslib.FSError:
+                    continue
+                if ent["type"] != fslib.T_DIR:
+                    continue
+                await m.export_dir(d, idle)
+                m.load.pop(d, None)
+                moves.append((d, busy, idle))
+                break
+        for m in self.mdss.values():
+            # half-life decay (the reference's DecayCounter)
+            m.load = {d: l / 2 for d, l in m.load.items() if l > 0.5}
+        return moves
+
+
+def _dec_submap(raw: bytes) -> dict[str, int]:
+    m, _ = denc.dec_map(raw, 0, denc.dec_bytes, denc.dec_bytes)
+    return {k.decode(): denc.dec_u32(v, 0)[0] for k, v in m.items()}
+
+
 def _enc_ent(ent: dict) -> dict[str, bytes]:
     return {
         "ino": denc.enc_u64(ent["ino"]),
@@ -621,6 +1008,12 @@ class FSClient:
         self.bus = bus
         self.name = name
         self.mds = mds
+        self._mds_base = mds.rsplit(".", 1)[0]
+        #: cached subtree-authority map (MDSMap role): path -> rank,
+        #: refreshed from every ESTALE redirect
+        self.submap: dict[str, int] = {"/": 0}
+        #: ino -> rank that granted our cap (close/setsize route there)
+        self._ino_rank: dict[int, int] = {}
         self.timeout = timeout
         #: optional write-back/read-ahead data cache (ObjectCacher
         #: role, cap-fenced: flushed+invalidated on revoke/close). The
@@ -634,6 +1027,7 @@ class FSClient:
             data_io = CacheIo(client, self._cacher)
         self.striper = RadosStriper(data_io, data_pool)
         self._tid = 0
+        self._last_rank = 0
         self._futs: dict[int, asyncio.Future] = {}
         #: ino -> buffered size under a held write cap
         self.wcaps: dict[int, int] = {}
@@ -669,22 +1063,46 @@ class FSClient:
                 self.name, src,
                 M.MCapRelease(ino=msg.ino, tid=msg.tid, size=size))
 
-    async def _req(self, verb: str, **args) -> dict[str, bytes]:
+    def _rank_for(self, path: str) -> int:
+        return _deepest_rank(self.submap, path)
+
+    def _route(self, verb: str, args: dict) -> int:
+        if verb in ("close", "setsize"):
+            # the cap lives at the rank that granted it
+            return self._ino_rank.get(args.get("ino"), 0)
+        p = args.get("path")
+        return self._rank_for(p) if isinstance(p, str) else 0
+
+    async def _send_once(self, rank: int, verb: str,
+                         enc: dict[str, bytes]):
         self._tid += 1
         tid = self._tid
         fut = asyncio.get_running_loop().create_future()
         self._futs[tid] = fut
+        try:
+            await self.bus.send(self.name, f"{self._mds_base}.{rank}",
+                                M.MClientRequest(tid=tid, verb=verb,
+                                                 args=enc))
+            return await asyncio.wait_for(fut, self.timeout)
+        finally:
+            self._futs.pop(tid, None)
+
+    async def _req(self, verb: str, **args) -> dict[str, bytes]:
         enc = {}
         for k, v in args.items():
             enc[k] = v.encode() if isinstance(v, str) else (
                 denc.enc_u64(v) if isinstance(v, int) else v)
-        try:
-            await self.bus.send(self.name, self.mds,
-                                M.MClientRequest(tid=tid, verb=verb,
-                                                 args=enc))
-            reply = await asyncio.wait_for(fut, self.timeout)
-        finally:
-            self._futs.pop(tid, None)
+        rank = self._route(verb, args)
+        for _attempt in range(4):
+            reply = await self._send_once(rank, verb, enc)
+            if reply.result == M.ESTALE and "submap" in reply.out:
+                # wrong rank: adopt the responder's subtree map and
+                # follow the redirect (MDSMap refresh role)
+                self.submap = _dec_submap(reply.out["submap"])
+                rank = denc.dec_u32(reply.out["rank"], 0)[0]
+                continue
+            break
+        self._last_rank = rank
         if reply.result != 0:
             if reply.result == M.ENOENT:
                 raise fslib.NoEnt(args.get("path", ""))
@@ -697,7 +1115,12 @@ class FSClient:
         if snapc_raw is not None:
             seq, off = denc.dec_u64(snapc_raw, 0)
             ids, _ = denc.dec_list(snapc_raw, off, denc.dec_u64)
-            self._snapc = (seq, ids)
+            # MERGE, don't replace: each rank's reply carries only the
+            # snaps it knows; a reply from rank A must never downgrade
+            # ids learned from rank B or a snapshot there loses its COW
+            merged = sorted(set(ids) | set(self._snapc[1]),
+                            reverse=True)
+            self._snapc = (max(seq, self._snapc[0]), merged)
         return reply.out
 
     async def _flush(self, ino: int) -> None:
@@ -739,6 +1162,7 @@ class FSClient:
         ino = self._paths.pop(path, None)
         if ino is not None:
             self.wcaps.pop(ino, None)
+            self._ino_rank.pop(ino, None)
         await self._req("unlink", path=path)
 
     async def create(self, path: str) -> int:
@@ -746,12 +1170,24 @@ class FSClient:
         ino = denc.dec_u64(out["ino"], 0)[0]
         self.wcaps[ino] = 0  # create grants the write cap
         self._paths[path] = ino
+        self._ino_rank[ino] = self._last_rank
         return ino
 
     async def open(self, path: str, mode: str = "r") -> int:
         out = await self._req("open", path=path, mode=mode)
         ino = denc.dec_u64(out["ino"], 0)[0]
         self._paths[path] = ino
+        if len(self._ino_rank) > 8192:
+            # routing hints, not state: shed capless entries so a
+            # file-churning client doesn't grow without bound. Inos
+            # with a LIVE write cap are kept — their close/setsize
+            # must still reach the granting rank.
+            for k in list(self._ino_rank):
+                if k not in self.wcaps:
+                    del self._ino_rank[k]
+                    if len(self._ino_rank) <= 4096:
+                        break
+        self._ino_rank[ino] = self._last_rank
         if mode == "w":
             self.wcaps[ino] = denc.dec_u64(out["size"], 0)[0]
         return ino

@@ -200,23 +200,85 @@ def _dec_entry(b: bytes) -> dict:
             "meta": meta}
 
 
-class _ClsIndex:
-    """Bucket index operations through the server-side cls_rgw class
-    (cluster/cls.py "rgw"): every update is atomic WITH the bucket
-    stats accounting inside one OSD op vector — the index is no longer
-    a client-maintained omap."""
+DATALOG_OID = b".rgw.datalog"
+
+
+class DataLog:
+    """Zone change log (the rgw_datalog.cc role): every index mutation
+    appends the touched (bucket, plain key) so a sync peer can replay
+    changes incrementally. Entries mark keys DIRTY — the syncer fetches
+    source-of-truth state per key, so replay is idempotent and a
+    coarse "key touched" record is enough (exactly the reference's
+    shard-marker stance, at key rather than shard granularity)."""
 
     def __init__(self, client, pool_id: int):
         self.client = client
         self.pool_id = pool_id
 
+    async def add(self, bucket: str, key: str) -> int:
+        raw = await self.client.execute(
+            self.pool_id, DATALOG_OID, "rgw", "datalog_add",
+            denc.enc_str(bucket) + denc.enc_str(key)
+            + denc.enc_u64(int(time.time())))
+        return denc.dec_u64(raw, 0)[0]
+
+    async def list(self, from_seq: int, max_entries: int = 1000
+                   ) -> tuple[int, list[tuple[int, str, str]], bool]:
+        """(head, [(seq, bucket, key)], truncated); head = the next
+        seq the log will mint (exclusive end of what exists now)."""
+        try:
+            raw = await self.client.execute(
+                self.pool_id, DATALOG_OID, "rgw", "datalog_list",
+                denc.enc_u64(from_seq) + denc.enc_u32(max_entries))
+        except KeyError:
+            return 0, [], False  # log object not created yet
+        head, off = denc.dec_u64(raw, 0)
+        n, off = denc.dec_u32(raw, off)
+        out = []
+        for _ in range(n):
+            seq, off = denc.dec_u64(raw, off)
+            ent, off = denc.dec_bytes(raw, off)
+            bucket, o = denc.dec_str(ent, 0)
+            key, o = denc.dec_str(ent, o)
+            out.append((seq, bucket, key))
+        truncated, _ = denc.dec_u8(raw, off)
+        return head, out, bool(truncated)
+
+    async def trim(self, upto: int) -> None:
+        await self.client.execute(
+            self.pool_id, DATALOG_OID, "rgw", "datalog_trim",
+            denc.enc_u64(upto))
+
+
+class _ClsIndex:
+    """Bucket index operations through the server-side cls_rgw class
+    (cluster/cls.py "rgw"): every update is atomic WITH the bucket
+    stats accounting inside one OSD op vector — the index is no longer
+    a client-maintained omap. ``log`` (a DataLog or None) records the
+    touched plain key after each mutation for multisite sync."""
+
+    def __init__(self, client, pool_id: int, log: DataLog | None = None):
+        self.client = client
+        self.pool_id = pool_id
+        self.log = log
+
+    async def _log(self, bucket: str, key: str) -> None:
+        if self.log is not None:
+            # version rows ("key\0v<order>") dirty their plain key
+            await self.log.add(bucket, key.split(_VSEP, 1)[0])
+
     async def put(self, bucket: str, key: str, entry: bytes) -> None:
+        # dirty-mark BEFORE mutating: a crash between the two ops then
+        # leaves at worst a spurious log entry (reconciled to a no-op),
+        # never a committed change the sync peer will miss forever
+        await self._log(bucket, key)
         await self.client.execute(
             self.pool_id, _index_oid(bucket), "rgw", "index_update",
             denc.enc_u8(0) + denc.enc_bytes(key.encode())
             + denc.enc_bytes(entry))
 
     async def delete(self, bucket: str, key: str) -> None:
+        await self._log(bucket, key)
         await self.client.execute(
             self.pool_id, _index_oid(bucket), "rgw", "index_update",
             denc.enc_u8(1) + denc.enc_bytes(key.encode()))
@@ -262,8 +324,14 @@ class _ClsIndex:
 
 
 class RGWLite:
-    def __init__(self, client, pool_id: int):
-        self.index = _ClsIndex(client, pool_id)
+    def __init__(self, client, pool_id: int, zone: str = "default",
+                 datalog: bool = False):
+        """``datalog=True`` makes this instance a multisite-capable
+        zone: every index mutation also appends to the zone's change
+        log (see DataLog / services/rgw_sync.py)."""
+        self.zone = zone
+        self.datalog = DataLog(client, pool_id) if datalog else None
+        self.index = _ClsIndex(client, pool_id, log=self.datalog)
         self.client = client
         self.pool_id = pool_id
         self.striper = RadosStriper(
@@ -280,6 +348,7 @@ class RGWLite:
         existing = await self._buckets()
         if bucket.encode() in existing:
             raise RGWError("BucketAlreadyExists", 409)
+        await self._log_bucket(bucket)
         await self.client.omap_set(
             self.pool_id, ROOT_OID,
             {bucket.encode(): denc.enc_u64(int(time.time()))},
@@ -293,9 +362,20 @@ class RGWLite:
                                          _index_oid(bucket))
         if idx:
             raise RGWError("BucketNotEmpty", 409)
+        await self._log_bucket(bucket)
         await self.client.delete(self.pool_id, _index_oid(bucket))
         await self.client.omap_rm(self.pool_id, ROOT_OID,
                                   [bucket.encode()])
+
+    async def _log_bucket(self, bucket: str) -> None:
+        """Bucket-level change (create/delete/config): a datalog entry
+        with key "" — the metadata-log (mdlog) role folded into the
+        datalog; the syncer reconciles bucket existence + attrs.
+        Logged BEFORE the mutation (dirty-mark-first, like the index
+        hook): a spurious entry reconciles to a no-op, a lost one
+        diverges the peer forever."""
+        if self.datalog is not None:
+            await self.datalog.add(bucket, "")
 
     async def list_buckets(self) -> list[str]:
         return sorted(b.decode() for b in (await self._buckets()))
@@ -322,6 +402,7 @@ class RGWLite:
         if status not in ("Enabled", "Suspended"):
             raise RGWError("IllegalVersioningConfigurationException")
         await self._require_bucket(bucket)
+        await self._log_bucket(bucket)
         await self.client.setxattr(self.pool_id, _index_oid(bucket),
                                    self.ATTR_VERSIONING, status.encode())
 
@@ -648,6 +729,7 @@ class RGWLite:
             + denc.enc_str(str(r["noncurrent_days"])
                            if r.get("noncurrent_days") is not None
                            else "")))
+        await self._log_bucket(bucket)
         await self.client.setxattr(self.pool_id, _index_oid(bucket),
                                    self.ATTR_LIFECYCLE, enc)
 
