@@ -9,12 +9,14 @@ external ones from any directory.
 from __future__ import annotations
 
 from .balancer import Module as BalancerModule
+from .dashboard import Module as DashboardModule
 from .pg_autoscaler import Module as PgAutoscalerModule
 from .prometheus import Module as PrometheusModule
 from .rgw_lc import Module as RgwLcModule
 
 BUILTIN = {
     "balancer": BalancerModule,
+    "dashboard": DashboardModule,
     "pg_autoscaler": PgAutoscalerModule,
     "prometheus": PrometheusModule,
     "rgw_lc": RgwLcModule,

@@ -171,3 +171,61 @@ def test_mgr_module_store_survives_mgr_restart(tmp_path):
         await c.stop()
 
     run(t())
+
+
+def test_dashboard_module():
+    """The dashboard mgr module serves the read-only web UI + JSON API
+    (src/pybind/mgr/dashboard monitoring-slice role)."""
+    async def t():
+        c = await make()
+        await c.client.write_full(1, "obj", b"data")
+        await asyncio.sleep(c.hb_interval * 3)  # reports flow
+        dash = c.mgr.modules["dashboard"]
+        for _ in range(50):
+            if dash.addr is not None:
+                break
+            await asyncio.sleep(0.05)
+        assert dash.addr is not None
+
+        async def get(path):
+            r, w = await asyncio.open_connection(*dash.addr)
+            w.write(f"GET {path} HTTP/1.1\r\nhost: x\r\n\r\n".encode())
+            await w.drain()
+            status = int((await r.readline()).split()[1])
+            hdrs = {}
+            while True:
+                line = await r.readline()
+                if line in (b"\r\n", b"\n", b""):
+                    break
+                k, v = line.decode().split(":", 1)
+                hdrs[k.strip().lower()] = v.strip()
+            body = await r.readexactly(int(hdrs.get("content-length",
+                                                    "0")))
+            w.close()
+            return status, body
+
+        code, body = await get("/")
+        page = body.decode()
+        assert code == 200 and "HEALTH_OK" in page
+        assert "osd.3" in page and "active" in page
+        import json as _json
+
+        code, body = await get("/api/status")
+        st = _json.loads(body)
+        assert code == 200 and st["osds"]["up"] == 4
+        code, body = await get("/api/osds")
+        osds = _json.loads(body)
+        assert len(osds) == 4 and all(o["up"] for o in osds)
+        code, _ = await get("/nope")
+        assert code == 404
+        # degraded cluster renders the warning banner
+        await c.kill_osd(3)
+        await c.wait_down(3, 20)
+        code, body = await get("/")
+        assert b"HEALTH_WARN" in body and b"OSD_DOWN" in body
+        # the `dashboard url` command answers with the bound address
+        out = await c.mgr.dispatch_command("dashboard url", {})
+        assert out["url"].startswith("http://127.0.0.1:")
+        await c.stop()
+
+    run(t())
