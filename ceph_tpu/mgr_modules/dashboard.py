@@ -52,6 +52,8 @@ class _Frontend(HttpFrontend):
 
     def __init__(self, module: "Module"):
         self.module = module
+        self._server = None  # stop() before start() must be a no-op
+        self.port = 0
 
     async def _handle(self, method: str, target: str, headers: dict,
                       body: bytes) -> tuple[int, dict, bytes]:
@@ -77,31 +79,62 @@ class _Frontend(HttpFrontend):
 
 
 class Module(MgrModule):
-    MODULE_OPTIONS = [{"name": "port", "default": "0"}]
-    COMMANDS = [{"cmd": "dashboard url",
-                 "desc": "bound address of the dashboard server"}]
+    """OPT-IN like the reference (`ceph mgr module enable dashboard`):
+    loading the module registers its commands but binds NO socket;
+    `dashboard start` (or the ``port`` module option) brings the
+    server up — a fleet of TestCluster/bench mgrs must not each open
+    an unauthenticated listener as a side effect of existing."""
+
+    MODULE_OPTIONS = [{"name": "port", "default": ""}]
+    COMMANDS = [
+        {"cmd": "dashboard start",
+         "desc": "bind the dashboard server (args: port, default "
+                 "ephemeral)"},
+        {"cmd": "dashboard url",
+         "desc": "bound address of the dashboard server"},
+    ]
 
     addr: tuple[str, int] | None = None
     _fe: _Frontend | None = None
+    _bind_lock: asyncio.Lock | None = None
 
     async def handle_command(self, cmd: str, args: dict):
+        if cmd == "dashboard start":
+            await self._bind(int(args.get("port", 0)))
         return {"url": f"http://{self.addr[0]}:{self.addr[1]}/"
                 if self.addr else None}
 
     # ------------------------------------------------------------ server
 
+    async def _bind(self, port: int) -> None:
+        # serialized: two concurrent starts must not double-bind (the
+        # overwritten listener would leak past shutdown)
+        if self._bind_lock is None:
+            self._bind_lock = asyncio.Lock()
+        async with self._bind_lock:
+            if self.addr is not None:
+                if port and port != self.addr[1]:
+                    raise IOError(
+                        f"dashboard already bound on port "
+                        f"{self.addr[1]}, not {port}")
+                return
+            self._fe = _Frontend(self)
+            host, bound = await self._fe.start(port=port)
+            self.addr = (host, bound)
+            self.log(f"dashboard on http://{host}:{bound}/")
+
     async def serve(self) -> None:
-        port = int(self.get_module_option("port", "0"))
-        self._fe = _Frontend(self)
-        self.addr = await self._fe.start(port=port)
-        self.log(f"dashboard on http://{self.addr[0]}:{self.addr[1]}/")
-        await asyncio.Event().wait()  # server lives until shutdown
+        port = self.get_module_option("port", "")
+        if port != "":
+            await self._bind(int(port))
 
     async def shutdown(self) -> None:
         if self._fe is not None:
             await self._fe.stop()
 
     def _osds(self) -> list[dict]:
+        # osd_map/reports come back as direct references (no copy, no
+        # recompute) — health() is the only computed get, fetched once
         osdmap = self.get("osd_map")
         reports = self.get("reports")
         return [{"osd": i, "up": bool(o.up),
@@ -111,8 +144,10 @@ class Module(MgrModule):
                 for i, o in enumerate(osdmap.osds)]
 
     def _page(self) -> bytes:
-        st = self.get("status")
+        # one fetch of each input per render: health once (status()
+        # embeds its own pass), osdmap/reports shared with the table
         he = self.get("health")
+        st = self.get("status")
         warn = he["status"] != "HEALTH_OK"
         checks = ("" if not he["checks"] else " — " + "; ".join(
             f"{k}: {v}" for k, v in sorted(he["checks"].items())))

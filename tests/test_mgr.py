@@ -181,11 +181,11 @@ def test_dashboard_module():
         await c.client.write_full(1, "obj", b"data")
         await asyncio.sleep(c.hb_interval * 3)  # reports flow
         dash = c.mgr.modules["dashboard"]
-        for _ in range(50):
-            if dash.addr is not None:
-                break
-            await asyncio.sleep(0.05)
+        # opt-in like the reference: no socket until `dashboard start`
+        assert dash.addr is None
+        out = await c.mgr.dispatch_command("dashboard start", {})
         assert dash.addr is not None
+        assert out["url"] == f"http://{dash.addr[0]}:{dash.addr[1]}/"
 
         async def get(path):
             r, w = await asyncio.open_connection(*dash.addr)
