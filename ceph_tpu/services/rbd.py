@@ -114,6 +114,22 @@ def _header(name: str) -> str:
     return f"rbd_header.{name}"
 
 
+def object_count(layout: FileLayout, size: int) -> int:
+    """Objects a ``size``-byte image can touch. NOT
+    ceil(size/object_size): striping round-robins stripe units across
+    ``stripe_count`` objects per object SET, so a small image on a
+    wide layout still spreads over the whole first set
+    (Striper::get_num_objects role)."""
+    if not size:
+        return 0
+    setsize = layout.object_size * layout.stripe_count
+    full, rem = divmod(size, setsize)
+    n = full * layout.stripe_count
+    if rem:
+        n += min(layout.stripe_count, -(-rem // layout.stripe_unit))
+    return n
+
+
 def _data_fmt(name: str) -> str:
     return f"rbd_data.{name}." + "{objectno:016x}"
 
@@ -153,7 +169,7 @@ class RBD:
         # seed an all-absent object map: the image is known empty here,
         # which spares the first lock holder the full stat sweep the
         # fresh-map rebuild would otherwise run (fast-diff from byte 0)
-        nobj = -(-size // layout.object_size) if size else 0
+        nobj = object_count(layout, size)
         seed = (ObjectOperation()
                 .create(exclusive=False)
                 .setxattr(ATTR_OMAP_BITS, bytes(nobj)))
@@ -784,21 +800,35 @@ class Image:
             # cached content drops AFTER the objects are cut, below
             await self._cacher.flush()
         if new_size < old:
-            # drop whole objects past the end, truncate the boundary one
+            # per-object retained byte counts under STRIPING: an
+            # object keeps the highest in-object offset any stripe
+            # unit of [0, new_size) maps to — the old sequential
+            # first_dead/boundary math deleted live mid-set objects
+            # on wide layouts (round-5 review finding)
             lo = self.layout
-            first_dead = -(-new_size // lo.object_size)
-            last = (old - 1) // lo.object_size if old else 0
-            for objno in range(first_dead, last + 1):
-                await self._rm_object(objno)
-            if new_size % lo.object_size:
-                oid = self._oid(new_size // lo.object_size)
-                try:
-                    await self.client.truncate(
-                        self.pool_id, oid, new_size % lo.object_size,
-                        snapc=self._snapc(),
-                    )
-                except KeyError:
-                    pass
+            fmt = _data_fmt(self.name)
+
+            def keep_map(upto: int) -> dict[int, int]:
+                m: dict[int, int] = {}
+                for ex in file_to_extents(lo, 0, upto, fmt):
+                    m[ex.objectno] = max(m.get(ex.objectno, 0),
+                                         ex.offset + ex.length)
+                return m
+
+            keep = keep_map(new_size) if new_size else {}
+            had = keep_map(old)
+            for objno in range(object_count(lo, old)):
+                want = keep.get(objno, 0)
+                if want == 0:
+                    await self._rm_object(objno)
+                elif want < had.get(objno, lo.object_size):
+                    try:
+                        await self.client.truncate(
+                            self.pool_id, self._oid(objno), want,
+                            snapc=self._snapc(),
+                        )
+                    except KeyError:
+                        pass
             if self._cacher is not None:
                 # objects are cut: NOW drop clean cache content
                 # (before the cut, a concurrent read could re-cache
@@ -974,8 +1004,7 @@ class Image:
     # ---------------------------------------------------------- objects
 
     def _object_count(self) -> int:
-        lo = self.layout
-        return -(-self.size // lo.object_size) if self.size else 0
+        return object_count(self.layout, self.size)
 
     async def _rm_object(self, objno: int):
         try:

@@ -203,15 +203,19 @@ class EncryptedImage:
         end = min(-(-(offset + len(data)) // BLOCK) * BLOCK,
                   self.image.size)
         async with self._wlock:
-            head = tail = b""
-            if start < offset:  # boundary RMW via the decrypting read
-                head = await self.read(start, offset - start)
-            tail_from = offset + len(data)
-            if end > tail_from:
-                tail = await self.read(tail_from, end - tail_from)
-            pt = head + data + tail
-            await self.image.write(start,
-                                   self._encrypt(start // BLOCK, pt))
+            await self._write_locked(offset, data, start, end)
+
+    async def _write_locked(self, offset: int, data: bytes,
+                            start: int, end: int) -> None:
+        head = tail = b""
+        if start < offset:  # boundary RMW via the decrypting read
+            head = await self.read(start, offset - start)
+        tail_from = offset + len(data)
+        if end > tail_from:
+            tail = await self.read(tail_from, end - tail_from)
+        pt = head + data + tail
+        await self.image.write(start,
+                               self._encrypt(start // BLOCK, pt))
 
     async def resize(self, new_size: int) -> None:
         if new_size % BLOCK:
@@ -226,16 +230,27 @@ class EncryptedImage:
         re-encrypted zeros."""
         end = min(offset + length, self.image.size)
         offset = min(offset, self.image.size)
-        a = -(-offset // BLOCK) * BLOCK  # first fully-covered block
-        b = (end // BLOCK) * BLOCK       # end of last covered block
-        if a < b:
-            await self.image.discard(a, b - a)
-            if offset < a:
-                await self.write(offset, b"\x00" * (a - offset))
-            if b < end:
-                await self.write(b, b"\x00" * (end - b))
-        elif offset < end:  # whole range inside one crypto block
-            await self.write(offset, b"\x00" * (end - offset))
+
+        async def zero(off: int, n: int) -> None:
+            z = b"\x00" * n
+            s0 = off - off % BLOCK
+            e0 = min(-(-(off + n) // BLOCK) * BLOCK, self.image.size)
+            await self._write_locked(off, z, s0, e0)
+
+        # the whole punch-then-rewrite runs under the write lock: a
+        # concurrent sub-block write's RMW interleaving with the punch
+        # would re-encrypt pre-discard bytes back in (round-5 review)
+        async with self._wlock:
+            a = -(-offset // BLOCK) * BLOCK  # first fully-covered blk
+            b = (end // BLOCK) * BLOCK       # end of last covered blk
+            if a < b:
+                await self.image.discard(a, b - a)
+                if offset < a:
+                    await zero(offset, a - offset)
+                if b < end:
+                    await zero(b, end - b)
+            elif offset < end:  # whole range inside one crypto block
+                await zero(offset, end - offset)
 
     async def close(self) -> None:
         await self.image.release_lock()

@@ -447,3 +447,66 @@ def test_migration_abort_restores_source():
         await c.stop()
 
     run(t())
+
+
+def test_wide_striping_flatten_rollback_remove():
+    """Regression: _object_count assumed sequential layout; with
+    stripe_count > 1 a small image spreads over the whole object SET,
+    and flatten/rollback/remove must sweep every object of the set
+    (Striper::get_num_objects role)."""
+    async def t():
+        c, rbd = await make()
+        wide = FileLayout(stripe_unit=4096, stripe_count=4,
+                          object_size=16384)
+        await rbd.create("w", 64 * 1024, wide)
+        img = await rbd.open("w")
+        rng = np.random.default_rng(9)
+        data = rng.integers(0, 256, 50_000, dtype=np.uint8).tobytes()
+        await img.write(0, data)
+        await img.snap_create("s")
+        await img.write(0, b"\x55" * len(data))
+        await rbd.clone("w", "s", "wc")
+        child = await rbd.open("wc")
+        await child.flatten()
+        # every object of the set was copied up, not just object 0
+        assert await child.read(0, len(data)) == data
+        await child.release_lock()
+        # rollback sweeps the whole set too
+        await img.snap_rollback("s")
+        assert await img.read(0, len(data)) == data
+        await img.release_lock()
+        # remove leaves no stray data objects behind
+        await rbd.remove("wc")
+        with pytest.raises(ImageNotFound):
+            await rbd.open("wc")
+        await c.stop()
+
+    run(t())
+
+
+def test_wide_striping_shrink_keeps_live_data():
+    """Regression: shrink used sequential object math and deleted
+    mid-set objects holding live striped data."""
+    async def t():
+        c, rbd = await make()
+        wide = FileLayout(stripe_unit=4096, stripe_count=4,
+                          object_size=16384)
+        await rbd.create("w", 64 * 1024, wide)
+        img = await rbd.open("w")
+        rng = np.random.default_rng(13)
+        data = rng.integers(0, 256, 64 * 1024,
+                            dtype=np.uint8).tobytes()
+        await img.write(0, data)
+        # shrink to 16384: stripe units 0-3 live at offset 0 of
+        # objects 0-3 — the old math deleted objects 1..3 outright
+        await img.resize(16384)
+        assert await img.read(0, 16384) == data[:16384]
+        # grow back: the cut range reads as zeros, the kept prefix
+        # stays intact
+        await img.resize(64 * 1024)
+        assert await img.read(0, 16384) == data[:16384]
+        assert await img.read(16384, 4096) == b"\x00" * 4096
+        await img.release_lock()
+        await c.stop()
+
+    run(t())
