@@ -114,6 +114,32 @@ def _header(name: str) -> str:
     return f"rbd_header.{name}"
 
 
+def retained_bytes(layout: FileLayout, upto: int,
+                   objno: int) -> int:
+    """Highest in-object offset any byte of file range [0, upto) maps
+    to in ``objno`` under striping — closed form, O(1) per object (an
+    extent enumeration would walk upto/stripe_unit rows). Property-
+    checked against file_to_extents over randomized layouts in
+    test_rbd.py."""
+    if upto <= 0:
+        return 0
+    su, sc = layout.stripe_unit, layout.stripe_count
+    upo = layout.object_size // su  # stripe units per object
+    nunits = -(-upto // su)         # touched file stripe units
+    setno, pos = objno // sc, objno % sc
+    limit = nunits - 1 - pos
+    if limit < 0:
+        return 0
+    r = limit // sc - setno * upo   # last in-object unit with data
+    if r < 0:
+        return 0
+    r = min(upo - 1, r)
+    f = (setno * upo + r) * sc + pos  # its file unit index
+    if f > nunits - 1:
+        return 0
+    return r * su + (su if f < nunits - 1 else upto - f * su)
+
+
 def object_count(layout: FileLayout, size: int) -> int:
     """Objects a ``size``-byte image can touch. NOT
     ceil(size/object_size): striping round-robins stripe units across
@@ -804,24 +830,14 @@ class Image:
             # object keeps the highest in-object offset any stripe
             # unit of [0, new_size) maps to — the old sequential
             # first_dead/boundary math deleted live mid-set objects
-            # on wide layouts (round-5 review finding)
+            # on wide layouts; closed-form per object, not an extent
+            # walk (both round-5 review findings)
             lo = self.layout
-            fmt = _data_fmt(self.name)
-
-            def keep_map(upto: int) -> dict[int, int]:
-                m: dict[int, int] = {}
-                for ex in file_to_extents(lo, 0, upto, fmt):
-                    m[ex.objectno] = max(m.get(ex.objectno, 0),
-                                         ex.offset + ex.length)
-                return m
-
-            keep = keep_map(new_size) if new_size else {}
-            had = keep_map(old)
             for objno in range(object_count(lo, old)):
-                want = keep.get(objno, 0)
+                want = retained_bytes(lo, new_size, objno)
                 if want == 0:
                     await self._rm_object(objno)
-                elif want < had.get(objno, lo.object_size):
+                elif want < retained_bytes(lo, old, objno):
                     try:
                         await self.client.truncate(
                             self.pool_id, self._oid(objno), want,

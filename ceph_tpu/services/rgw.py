@@ -203,32 +203,29 @@ def _dec_entry(b: bytes) -> dict:
 DATALOG_OID = b".rgw.datalog"
 
 
-class DataLog:
-    """Zone change log (the rgw_datalog.cc role): every index mutation
-    appends the touched (bucket, plain key) so a sync peer can replay
-    changes incrementally. Entries mark keys DIRTY — the syncer fetches
-    source-of-truth state per key, so replay is idempotent and a
-    coarse "key touched" record is enough (exactly the reference's
-    shard-marker stance, at key rather than shard granularity)."""
+class ClsLog:
+    """Atomic-seq append log over the server-side ``rgw.datalog_*``
+    cls methods (the cls_log/cls_queue role): opaque entries keyed by
+    a sequence the OSD allocates atomically with the write. Backs the
+    multisite DataLog and notification topic queues."""
 
-    def __init__(self, client, pool_id: int):
+    def __init__(self, client, pool_id: int, oid: bytes):
         self.client = client
         self.pool_id = pool_id
+        self.oid = oid
 
-    async def add(self, bucket: str, key: str) -> int:
+    async def append(self, entry: bytes) -> int:
         raw = await self.client.execute(
-            self.pool_id, DATALOG_OID, "rgw", "datalog_add",
-            denc.enc_str(bucket) + denc.enc_str(key)
-            + denc.enc_u64(int(time.time())))
+            self.pool_id, self.oid, "rgw", "datalog_add", entry)
         return denc.dec_u64(raw, 0)[0]
 
-    async def list(self, from_seq: int, max_entries: int = 1000
-                   ) -> tuple[int, list[tuple[int, str, str]], bool]:
-        """(head, [(seq, bucket, key)], truncated); head = the next
-        seq the log will mint (exclusive end of what exists now)."""
+    async def entries(self, from_seq: int, max_entries: int = 1000
+                      ) -> tuple[int, list[tuple[int, bytes]], bool]:
+        """(head, [(seq, raw entry)], truncated); head = the next seq
+        the log will mint (exclusive end of what exists now)."""
         try:
             raw = await self.client.execute(
-                self.pool_id, DATALOG_OID, "rgw", "datalog_list",
+                self.pool_id, self.oid, "rgw", "datalog_list",
                 denc.enc_u64(from_seq) + denc.enc_u32(max_entries))
         except KeyError:
             return 0, [], False  # log object not created yet
@@ -238,16 +235,43 @@ class DataLog:
         for _ in range(n):
             seq, off = denc.dec_u64(raw, off)
             ent, off = denc.dec_bytes(raw, off)
-            bucket, o = denc.dec_str(ent, 0)
-            key, o = denc.dec_str(ent, o)
-            out.append((seq, bucket, key))
+            out.append((seq, ent))
         truncated, _ = denc.dec_u8(raw, off)
         return head, out, bool(truncated)
 
     async def trim(self, upto: int) -> None:
         await self.client.execute(
-            self.pool_id, DATALOG_OID, "rgw", "datalog_trim",
+            self.pool_id, self.oid, "rgw", "datalog_trim",
             denc.enc_u64(upto))
+
+
+class DataLog(ClsLog):
+    """Zone change log (the rgw_datalog.cc role): every index mutation
+    appends the touched (bucket, plain key) so a sync peer can replay
+    changes incrementally. Entries mark keys DIRTY — the syncer fetches
+    source-of-truth state per key, so replay is idempotent and a
+    coarse "key touched" record is enough (exactly the reference's
+    shard-marker stance, at key rather than shard granularity)."""
+
+    def __init__(self, client, pool_id: int):
+        super().__init__(client, pool_id, DATALOG_OID)
+
+    async def add(self, bucket: str, key: str) -> int:
+        return await self.append(
+            denc.enc_str(bucket) + denc.enc_str(key)
+            + denc.enc_u64(int(time.time())))
+
+    async def list(self, from_seq: int, max_entries: int = 1000
+                   ) -> tuple[int, list[tuple[int, str, str]], bool]:
+        """(head, [(seq, bucket, key)], truncated)."""
+        head, raw, truncated = await self.entries(from_seq,
+                                                  max_entries)
+        out = []
+        for seq, ent in raw:
+            bucket, o = denc.dec_str(ent, 0)
+            key, o = denc.dec_str(ent, o)
+            out.append((seq, bucket, key))
+        return head, out, truncated
 
 
 class _ClsIndex:
@@ -330,6 +354,9 @@ class RGWLite:
         zone: every index mutation also appends to the zone's change
         log (see DataLog / services/rgw_sync.py)."""
         self.zone = zone
+        #: bucket -> (expiry, rules) notification-config TTL cache
+        #: (rgw_notify role; see services/rgw_notify.py)
+        self._notif_cache: dict[str, tuple[float, list]] = {}
         self.datalog = DataLog(client, pool_id) if datalog else None
         self.index = _ClsIndex(client, pool_id, log=self.datalog)
         self.client = client
@@ -456,7 +483,8 @@ class RGWLite:
 
     async def put_object(self, bucket: str, key: str, data: bytes,
                          content_type: str = "",
-                         meta: dict[str, str] | None = None
+                         meta: dict[str, str] | None = None,
+                         _event: str = "s3:ObjectCreated:Put"
                          ) -> str | tuple[str, str]:
         """Returns the etag; on a versioning-enabled bucket returns
         (etag, version_id). ``content_type``/``meta`` ride the index
@@ -479,6 +507,8 @@ class RGWLite:
             await self.index.put(bucket, _ver_index_key(key, vid),
                                  entry)
             await self.index.put(bucket, key, entry)
+            await self._notify(bucket, key, _event, size=len(data),
+                               etag=etag, version_id=vid)
             return etag, vid
         oid = _data_oid(bucket, key)
         if len(data) > STRIPE_THRESHOLD:
@@ -489,7 +519,20 @@ class RGWLite:
         await self.index.put(bucket, key,
                              _enc_entry(len(data), etag, time.time(),
                                         ctype=content_type, meta=meta))
+        await self._notify(bucket, key, _event, size=len(data),
+                           etag=etag)
         return etag
+
+    async def _notify(self, bucket: str, key: str, event: str,
+                      size: int = 0, etag: str = "",
+                      version_id: str = "") -> None:
+        """Bucket-notification emission (rgw_notify role); lazy import
+        breaks the module cycle. Reliable like the reference's
+        persistent topics: a failed queue append fails the op."""
+        from . import rgw_notify
+
+        await rgw_notify.emit(self, bucket, key, event, size=size,
+                              etag=etag, version_id=version_id)
 
     async def _preserve_null_version(self, bucket: str,
                                      key: str) -> None:
@@ -603,6 +646,9 @@ class RGWLite:
             await self.index.put(bucket, _ver_index_key(key, vid),
                                  entry)
             await self.index.put(bucket, key, entry)
+            await self._notify(bucket, key,
+                               "s3:ObjectRemoved:DeleteMarkerCreated",
+                               version_id=vid)
             return vid
         if versioned and version_id:
             ent = await self._find_version(bucket, key, version_id)
@@ -631,11 +677,14 @@ class RGWLite:
             if cur["version_id"] == ent["version_id"] or (
                     version_id == "null" and not cur["version_id"]):
                 await self._promote_newest(bucket, key)
+            await self._notify(bucket, key, "s3:ObjectRemoved:Delete",
+                               version_id=version_id)
             return version_id
         # unversioned bucket
         meta = await self.head_object(bucket, key)
         await self._delete_plain_data(bucket, key, meta)
         await self.index.delete(bucket, key)
+        await self._notify(bucket, key, "s3:ObjectRemoved:Delete")
         return ""
 
     async def _delete_plain_data(self, bucket: str, key: str,
@@ -679,7 +728,8 @@ class RGWLite:
         return await self.put_object(
             dst_bucket, dst_key, data,
             content_type=src["content_type"],
-            meta=src["meta"] if meta is None else meta)
+            meta=src["meta"] if meta is None else meta,
+            _event="s3:ObjectCreated:Copy")
 
     async def list_objects(self, bucket: str, prefix: str = "",
                            marker: str = "", max_keys: int = 1000):
@@ -858,6 +908,10 @@ class RGWLite:
                     await self.client.delete(self.pool_id, oid)
                 except KeyError:
                     pass
+            await self._notify(
+                bucket, key,
+                "s3:ObjectCreated:CompleteMultipartUpload",
+                size=total, etag=etag, version_id=vid)
             return etag, vid
         enc = denc.enc_list(
             manifest,
@@ -869,6 +923,9 @@ class RGWLite:
         await self.index.put(bucket, key,
                              _enc_entry(total, etag, time.time(),
                                         multipart=True))
+        await self._notify(
+            bucket, key, "s3:ObjectCreated:CompleteMultipartUpload",
+            size=total, etag=etag)
         return etag
 
     async def _read_multipart(self, bucket: str, key: str) -> bytes:

@@ -510,3 +510,30 @@ def test_wide_striping_shrink_keeps_live_data():
         await c.stop()
 
     run(t())
+
+
+def test_retained_bytes_matches_extent_enumeration():
+    """Property check: the closed-form shrink math equals brute-force
+    extent enumeration across randomized layouts."""
+    import random
+
+    from ceph_tpu.osdc.striper import file_to_extents
+    from ceph_tpu.services.rbd import retained_bytes
+
+    random.seed(7)
+    for _ in range(500):
+        su = random.choice([512, 4096, 65536])
+        sc = random.choice([1, 2, 4, 7])
+        upo = random.choice([1, 2, 4, 8])
+        lo = FileLayout(stripe_unit=su, stripe_count=sc,
+                        object_size=su * upo)
+        upto = random.randrange(0, su * upo * sc * 3 + 3)
+        want = {}
+        if upto:
+            for ex in file_to_extents(lo, 0, upto, "o{objectno}"):
+                want[ex.objectno] = max(want.get(ex.objectno, 0),
+                                        ex.offset + ex.length)
+        hi = max(want.keys(), default=-1) + 3
+        for objno in range(hi):
+            assert retained_bytes(lo, upto, objno) == \
+                want.get(objno, 0), (lo, upto, objno)
