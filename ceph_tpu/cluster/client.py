@@ -22,9 +22,24 @@ class RadosError(IOError):
     """Op-vector failure with its errno-style code attached (librados
     negative-errno contract); str() keeps the legacy message shape."""
 
+    ENODATA = -61
+
     def __init__(self, code: int, what: str = ""):
         super().__init__(what or f"op vector failed: {code}")
         self.code = code
+
+
+def absent_attr(e: BaseException) -> bool:
+    """True only when an xattr/object read failed because the thing
+    genuinely is not there: missing object (ENOENT -> KeyError) or
+    missing xattr (ENODATA). Everything else — transient op failures,
+    EBLOCKLISTED — is a real error the caller must not fold into
+    "absent" (shared by rbd_crypto keyslot probes and rgw_notify
+    config reads, where that misreading destroys data or drops
+    events)."""
+    if isinstance(e, KeyError):
+        return True
+    return isinstance(e, RadosError) and e.code == RadosError.ENODATA
 
 
 @dataclass
@@ -64,9 +79,32 @@ class RadosClient:
     # ---------------------------------------------------------- lifecycle
 
     async def connect(self) -> None:
+        """Register + subscribe, RE-SENDING the subscription until the
+        first map lands. A one-shot subscribe is lossy across a
+        crash-restart that reuses our entity name: the mon still holds
+        a connection to the dead predecessor, and TCP silently buffers
+        the first write to a dead peer — the reply vanishes, no error
+        anywhere. Resending (MonClient hunt role) rides a fresh
+        connection once the stale one RSTs."""
         self.bus.register(self.name, self.handle)
-        await self._mon_send(M.MMonSubscribe(what="osdmap"))
-        await self._wait_for_map()
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + self.op_timeout
+        while self.osdmap is None:
+            left = deadline - loop.time()
+            if left <= 0:
+                raise TimeoutError(f"{self.name}: no osdmap from mon")
+            try:
+                await self._mon_send(M.MMonSubscribe(what="osdmap"),
+                                     deadline_s=min(2.0, left))
+            except IOError:
+                continue  # mon mid-failover: hunt again until timeout
+            fut = loop.create_future()
+            self._map_waiters.append(fut)
+            try:
+                await asyncio.wait_for(fut, min(1.0, left))
+            except asyncio.TimeoutError:
+                if fut in self._map_waiters:
+                    self._map_waiters.remove(fut)
 
     async def _mon_send(self, msg, deadline_s: float | None = None
                         ) -> None:
@@ -79,12 +117,6 @@ class RadosClient:
 
     async def close(self) -> None:
         self.bus.unregister(self.name)
-
-    async def _wait_for_map(self) -> None:
-        while self.osdmap is None:
-            fut = asyncio.get_running_loop().create_future()
-            self._map_waiters.append(fut)
-            await asyncio.wait_for(fut, self.op_timeout)
 
     # ------------------------------------------------------------ dispatch
 

@@ -113,6 +113,18 @@ async def _amain(args) -> None:
             os.path.join(args.store_dir, f"osd.{args.id}"))
         daemon = OSDLite(bus, args.id, store=store,
                          hb_interval=args.hb_interval)
+    elif args.role == "mds":
+        # metadata daemon (src/ceph_mds.cc main role): its own RADOS
+        # client on the bus; metadata pool via --pool. Spawned AFTER
+        # the pool exists (ProcCluster.start_mds orchestration).
+        from ..services.mds import MDSLite
+        from .client import RadosClient
+
+        client = RadosClient(bus, name=f"client.mds{args.id}")
+        await client.connect()
+        daemon = MDSLite(
+            bus, client, args.pool, name=f"mds.{args.id}",
+            data_pool=args.data_pool if args.data_pool >= 0 else None)
     else:
         raise SystemExit(f"unknown role {args.role!r}")
 
@@ -153,9 +165,14 @@ async def _amain(args) -> None:
 
 def main(argv=None) -> None:
     ap = argparse.ArgumentParser(prog="ceph-tpu-daemon")
-    ap.add_argument("--role", required=True, choices=["mon", "osd"])
+    ap.add_argument("--role", required=True,
+                    choices=["mon", "osd", "mds"])
     ap.add_argument("--id", type=int, default=0,
-                    help="osd id / mon rank")
+                    help="osd id / mon rank / mds rank")
+    ap.add_argument("--pool", type=int, default=1,
+                    help="mds: metadata pool id")
+    ap.add_argument("--data-pool", type=int, default=-1,
+                    help="mds: data pool id (-1 = metadata pool)")
     ap.add_argument("--book", required=True,
                     help="shared address-book directory")
     ap.add_argument("--store-dir", required=True)

@@ -49,6 +49,9 @@ class ProcCluster:
                         + [f"mon.{r}" for r in range(n_mons)]
                         + [f"osd.{i}" for i in range(n_osds)]
                         + [f"client.{i}" for i in range(4)]
+                        + [f"mds.{r}" for r in range(4)]
+                        + [f"client.mds{r}" for r in range(4)]
+                        + [f"fsclient.{i}" for i in range(4)]
                         + ["mgr", "node"])
             # the node key authenticates the PROCESS link; every
             # envelope is additionally signed with its src ENTITY's key
@@ -63,7 +66,8 @@ class ProcCluster:
 
     # ----------------------------------------------------------- lifecycle
 
-    def _spawn(self, role: str, ident: int) -> subprocess.Popen:
+    def _spawn(self, role: str, ident: int,
+               extra: list[str] | None = None) -> subprocess.Popen:
         ready = os.path.join(self.book, f"{role}.{ident}.ready")
         try:
             os.unlink(ready)
@@ -94,6 +98,8 @@ class ProcCluster:
             "--objectstore", self.objectstore,
             "--platform", platform,
         ]
+        if extra:
+            args.extend(extra)
         if self.secure:
             args.append("--secure")
         log = open(os.path.join(self.data_dir,
@@ -174,6 +180,31 @@ class ProcCluster:
     async def revive_osd(self, i: int) -> None:
         self._spawn("osd", i)
         await self._wait_ready("osd", i)
+
+    async def start_mds(self, rank: int, pool: int,
+                        data_pool: int | None = None) -> None:
+        """Spawn an MDS daemon process (after its metadata pool exists
+        and the fs is mkfs'd — the ceph-mds launch ordering)."""
+        if not hasattr(self, "_mds_args"):
+            self._mds_args: dict[int, list[str]] = {}
+        self._mds_args[rank] = [
+            "--pool", str(pool), "--data-pool",
+            str(-1 if data_pool is None else data_pool)]
+        self._spawn("mds", rank, extra=self._mds_args[rank])
+        await self._wait_ready("mds", rank)
+
+    def kill_mds(self, rank: int, sig: int = signal.SIGKILL) -> None:
+        """Crash-stop the MDS process; its journal is the recovery
+        story (MDLog replay on revive)."""
+        proc = self.procs.get(f"mds.{rank}")
+        assert proc is not None and proc.poll() is None
+        proc.send_signal(sig)
+        proc.wait()
+        self.procs[f"mds.{rank}"] = None
+
+    async def revive_mds(self, rank: int) -> None:
+        self._spawn("mds", rank, extra=self._mds_args[rank])
+        await self._wait_ready("mds", rank)
 
     def kill_mon(self, rank: int, sig: int = signal.SIGKILL) -> None:
         proc = self.procs.get(f"mon.{rank}")

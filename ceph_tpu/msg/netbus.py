@@ -221,7 +221,16 @@ class NetBus:
         if handler is None:
             return  # entity moved/died after the sender resolved it
         msg = decode_message(env.mtype, env.payload)
-        await handler(env.src, msg)
+        # scheduled, NEVER inline (the LocalBus re-entrancy stance,
+        # same as local delivery above): an inline await would run the
+        # handler inside this connection's read loop — a handler that
+        # awaits a reply from the same peer (cap recall inside a
+        # rename, MDS peer requests) then deadlocks against its own
+        # unread inbound frames until its timeout fires
+        task = asyncio.get_running_loop().create_task(
+            handler(env.src, msg))
+        self._tasks.add(task)
+        task.add_done_callback(self._tasks.discard)
 
     async def drain(self) -> None:
         """Local-delivery drain (LocalBus parity; cross-process traffic

@@ -37,28 +37,16 @@ import asyncio
 import hashlib
 import os
 
-from ..cluster.client import RadosError
+from ..cluster.client import absent_attr as _no_header
 from .rbd import Image, RBD
 
 CRYPT_ATTR = "rbd.crypt"
-_ENODATA = -61
 BLOCK = 4096
 _PBKDF2_ITERS = 100_000
 
 
 class WrongPassphrase(Exception):
     pass
-
-
-def _no_header(e: BaseException) -> bool:
-    """True only for "the header genuinely is not there": missing
-    object (ENOENT -> KeyError) or missing xattr (ENODATA). Transient
-    RADOS errors and EBLOCKLISTED must NOT read as "unformatted" — in
-    format that misreading would mint a fresh key over a live keyslot
-    and orphan all existing ciphertext."""
-    if isinstance(e, KeyError):
-        return True
-    return isinstance(e, RadosError) and e.code == _ENODATA
 
 
 def _kek(passphrase: str, salt: bytes) -> bytes:

@@ -31,22 +31,11 @@ from __future__ import annotations
 import json
 import time
 
-from ..cluster.client import RadosError
+from ..cluster.client import absent_attr as _no_config
 from .rgw import ClsLog, RGWError, RGWLite, _index_oid
 
 TOPICS_OID = b".rgw.topics"
 ATTR_NOTIFY = "rgw.notify"
-_ENODATA = -61
-
-
-def _no_config(e: BaseException) -> bool:
-    """Only a genuinely-missing xattr/object means "no rules".
-    Transient RADOS errors must PROPAGATE (failing the op) — mapping
-    them to "no rules" would silently drop events and break the
-    reliable-delivery contract."""
-    if isinstance(e, KeyError):
-        return True
-    return isinstance(e, RadosError) and e.code == _ENODATA
 
 
 def _topic_oid(name: str) -> bytes:
@@ -71,6 +60,17 @@ async def list_topics(rgw: RGWLite) -> list[str]:
 
 
 async def delete_topic(rgw: RGWLite, name: str) -> None:
+    """Refuses while any bucket's rules still reference the topic —
+    otherwise those rules would keep publishing and the WR cls append
+    would silently resurrect the deleted queue object with no
+    consumer (round-5 review finding)."""
+    for bucket in await rgw.list_buckets():
+        for r in await get_bucket_notification(rgw, bucket):
+            if r.get("topic") == name:
+                raise RGWError(
+                    "Conflict", 409,
+                    f"topic {name!r} still referenced by bucket "
+                    f"{bucket!r}")
     await rgw.client.omap_rm(rgw.pool_id, TOPICS_OID, [name.encode()])
     try:
         await rgw.client.delete(rgw.pool_id, _topic_oid(name))

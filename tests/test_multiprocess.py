@@ -287,3 +287,46 @@ def test_multiprocess_ec_pool(tmp_path):
             await c.stop()
 
     run(t())
+
+
+def test_multiprocess_mds_kill9_replay(tmp_path):
+    """The CephFS metadata daemon as a real OS process: client ops
+    over kernel sockets, kill -9 mid-workload, cold restart replays
+    the MDLog journal and the namespace survives (the ceph-mds +
+    qa fs-recovery role)."""
+    async def t():
+        from ceph_tpu.services.fs import FSLite
+        from ceph_tpu.services.mds import FSClient
+
+        c = await make(tmp_path)
+        try:
+            await FSLite(c.client, 1).mkfs()
+            await c.start_mds(0, pool=1)
+            fs = FSClient(c.bus, c.client, 1, name="fsclient.0",
+                          timeout=30.0)
+            await fs.connect()
+            await fs.mkdir("/proj")
+            await fs.create("/proj/a")
+            await fs.write("/proj/a", b"payload-one")
+            assert await fs.read("/proj/a") == b"payload-one"
+            # crash-stop the metadata authority mid-stream
+            c.kill_mds(0)
+            with pytest.raises((OSError, asyncio.TimeoutError)):
+                await asyncio.wait_for(fs.mkdir("/proj/lost"), 3)
+            # cold restart: journal replay restores the namespace
+            await c.revive_mds(0)
+            assert sorted(await fs.listdir("/proj")) == ["a"]
+            assert await fs.read("/proj/a") == b"payload-one"
+            await fs.mkdir("/proj/sub")
+            await fs.create("/proj/sub/b")
+            await fs.write("/proj/sub/b", b"after-revival")
+            assert await fs.read("/proj/sub/b") == b"after-revival"
+            # rename spans two dirfrags: the journaled path, over
+            # real sockets
+            await fs.rename("/proj/sub/b", "/proj/b2")
+            assert await fs.read("/proj/b2") == b"after-revival"
+            await fs.close()
+        finally:
+            await c.stop()
+
+    run(t())

@@ -109,7 +109,11 @@ def test_filters_and_versioned_markers():
         await rgw.put_object("quiet", "k", b"x")
         events, _m, _tr = await qrm.pull()
         assert len(events) == 2
-        await delete_topic(rgw, "creates")
+        # a topic still referenced by live rules refuses deletion —
+        # its queue would keep filling with no consumer
+        with pytest.raises(RGWError, match="still referenced"):
+            await delete_topic(rgw, "rm")
+        await delete_topic(rgw, "creates")  # unreferenced: fine
         assert await list_topics(rgw) == ["rm"]
         await c.stop()
 
