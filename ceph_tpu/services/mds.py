@@ -129,6 +129,9 @@ class MDSLite:
             self.rank = 0
         #: path -> owning rank; "/" is rank 0 unless exported
         self.subtrees: dict[str, int] = {"/": 0}
+        #: subtrees a CLIENT pinned (ceph.dir.pin role): sticky — the
+        #: balancer never moves them
+        self.pins: set[str] = set()
         #: decaying per-top-level-dir request counters (MDBalancer
         #: load model role)
         self.load: dict[str, float] = {}
@@ -173,14 +176,19 @@ class MDSLite:
 
     async def _load_subtrees(self) -> None:
         subtrees = {"/": 0}
+        pins: set[str] = set()
         try:
             omap = await self.client.omap_get(self.meta_pool,
                                               SUBTREE_OID)
         except KeyError:
             omap = {}
         for k, v in omap.items():
-            subtrees[k.decode()] = denc.dec_u32(v, 0)[0]
+            rank, off = denc.dec_u32(v, 0)
+            subtrees[k.decode()] = rank
+            if off < len(v) and denc.dec_u8(v, off)[0]:
+                pins.add(k.decode())
         self.subtrees = subtrees
+        self.pins = pins
 
     def auth_rank(self, path: str) -> int:
         return _deepest_rank(self.subtrees, path)
@@ -191,33 +199,71 @@ class MDSLite:
              for k, v in self.subtrees.items()},
             denc.enc_bytes, denc.enc_bytes)
 
-    async def export_dir(self, path: str, target: int) -> None:
+    #: wire value for "remove the pin/export row" (ceph.dir.pin -1)
+    UNPIN = 0xFFFFFFFF
+
+    async def export_dir(self, path: str, target: int,
+                         pinned: bool = False) -> None:
         """Hand authority for directory ``path`` to ``target`` rank
         (the Migrator::export_dir role, reduced to cap recall + a
         durable map flip — see SUBTREE_OID note)."""
+        async with self._lock:
+            await self._export_locked(path, target, pinned)
+
+    async def _export_locked(self, path: str, target: int,
+                             pinned: bool = False) -> None:
         p = _norm(path)
         if p == "/":
             raise fslib.FSError("cannot export the root")
-        async with self._lock:
-            if self.auth_rank(p) != self.rank:
-                raise fslib.FSError(f"{p} not ours to export")
-            ent = await self.fs.stat(p)
-            if ent["type"] != fslib.T_DIR:
-                raise fslib.FSError(f"{p} is not a directory")
-            # recall every write cap under the subtree (all ranks):
-            # buffered sizes must land in dentries the new authority
-            # will read
-            await self._recall_subtree(p)
-            args = {"path": p.encode(), "rank": denc.enc_u32(target)}
-            seq = await self._journal("export", args)
-            await self._apply_export(p, target)
-            await self._expire(seq)
+        if self.auth_rank(p) != self.rank:
+            raise fslib.FSError(f"{p} not ours to export")
+        ent = await self.fs.stat(p)
+        if ent["type"] != fslib.T_DIR:
+            raise fslib.FSError(f"{p} is not a directory")
+        if target != self.rank and target != self.UNPIN:
+            # the target rank must be ALIVE before the durable flip:
+            # an export to a nonexistent rank blackholes the subtree
+            # (every later op — the corrective re-pin included —
+            # routes to nobody). peer_recall with a match-nothing
+            # path doubles as the liveness ping.
+            try:
+                await self._peer_req(target, "peer_recall",
+                                     {"path": b"/\x00none"})
+            except Exception:
+                # SendError (no such entity), timeout, anything: the
+                # rank is not answering — refuse the flip
+                raise fslib.FSError(
+                    f"mds rank {target} unreachable: not exporting") \
+                    from None
+        # recall every write cap under the subtree (all ranks):
+        # buffered sizes must land in dentries the new authority
+        # will read
+        await self._recall_subtree(p)
+        args = {"path": p.encode(), "rank": denc.enc_u32(target)}
+        if pinned:
+            args["pin"] = denc.enc_u8(1)
+        seq = await self._journal("export", args)
+        await self._apply_export(p, target, pinned)
+        await self._expire(seq)
 
-    async def _apply_export(self, path: str, target: int) -> None:
+    async def _apply_export(self, path: str, target: int,
+                            pinned: bool = False) -> None:
+        if target == self.UNPIN:
+            # revert to the parent subtree's authority
+            await self.client.omap_rm(self.meta_pool, SUBTREE_OID,
+                                      [path.encode()])
+            self.subtrees.pop(path, None)
+            self.pins.discard(path)
+            return
         await self.client.omap_set(
             self.meta_pool, SUBTREE_OID,
-            {path.encode(): denc.enc_u32(target)})
+            {path.encode(): denc.enc_u32(target)
+             + denc.enc_u8(1 if pinned else 0)})
         self.subtrees[path] = target
+        if pinned:
+            self.pins.add(path)
+        else:
+            self.pins.discard(path)
 
     # ------------------------------------------------------- peer requests
 
@@ -693,6 +739,15 @@ class MDSLite:
         return {}
 
     async def _serve_mutation(self, src, verb, args, path):
+        if verb == "setpin":
+            # the ceph.dir.pin xattr role: a CLIENT pins a subtree to
+            # a rank (sticky: the balancer skips it; UNPIN removes the
+            # row); the current authority (requests route here by
+            # path) exports it — how multi-MDS is driven over the
+            # wire, no in-process handle on the daemon needed
+            await self._export_locked(
+                path, denc.dec_u32(args["rank"], 0)[0], pinned=True)
+            return {}
         if verb == "create":
             ent = None
             try:
@@ -876,8 +931,10 @@ class MDSLite:
             await self._apply_rmsnap(root, args["name"].decode(), sid)
             return {}
         if verb == "export":
-            await self._apply_export(args["path"].decode(),
-                                     denc.dec_u32(args["rank"], 0)[0])
+            await self._apply_export(
+                args["path"].decode(),
+                denc.dec_u32(args["rank"], 0)[0],
+                pinned=bool(args.get("pin", b"\x00")[0]))
             return {}
         raise fslib.FSError(f"verb {verb!r}")
 
@@ -965,7 +1022,8 @@ class MDBalancer:
             m = self.mdss[busy]
             for _l, d in sorted(
                     ((l, d) for d, l in m.load.items()
-                     if d != "/" and m.auth_rank(d) == m.rank),
+                     if d != "/" and m.auth_rank(d) == m.rank
+                     and d not in m.pins),  # pins are sticky
                     reverse=True):
                 try:
                     ent = await m.fs.stat(d)
@@ -1135,6 +1193,19 @@ class FSClient:
 
     async def mkdir(self, path: str) -> None:
         await self._req("mkdir", path=path)
+
+    async def set_subtree_pin(self, path: str, rank: int) -> None:
+        """Pin directory ``path``'s subtree to an MDS rank (the
+        ceph.dir.pin export-pin role, sticky vs the balancer); the
+        owning rank exports it. ``rank=-1`` removes the pin (the
+        subtree reverts to its parent's authority)."""
+        await self._req("setpin", path=path,
+                        rank=denc.enc_u32(rank & 0xFFFFFFFF))
+        # our map is stale the moment the export lands
+        if rank < 0:
+            self.submap.pop(_norm(path), None)
+        else:
+            self.submap[_norm(path)] = rank
 
     async def rmdir(self, path: str) -> None:
         await self._req("rmdir", path=path)

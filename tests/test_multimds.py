@@ -189,6 +189,38 @@ def test_balancer_moves_hot_subtree():
     run(t())
 
 
+def test_client_pin_sticky_and_validated():
+    """set_subtree_pin (ceph.dir.pin role): client-driven, sticky
+    against the balancer, unpinnable, and rejected for dead ranks."""
+    async def t():
+        c, (m0, m1), cl = await make()
+        await cl.mkdir("/pinned")
+        await cl.create("/pinned/f")
+        await cl.set_subtree_pin("/pinned", 1)
+        assert m0.auth_rank("/pinned") == 1
+        await cl.write("/pinned/f", b"x" * 10)
+        assert (await cl.stat("/pinned/f"))["size"] == 10
+        # hammer rank 1 so the balancer would WANT to move /pinned —
+        # the pin keeps it put
+        for _ in range(40):
+            await cl.listdir("/pinned")
+        bal = MDBalancer([m0, m1], ratio=2.0, min_load=8.0)
+        assert await bal.tick() == []
+        assert m1.auth_rank("/pinned") == 1
+        # unpin reverts to the parent's authority (rank 0)
+        await cl.set_subtree_pin("/pinned", -1)
+        assert "/pinned" not in m1.subtrees
+        assert await cl.read("/pinned/f") == b"x" * 10  # via rank 0
+        # pinning to a rank that does not exist is refused before the
+        # durable flip — no blackholed subtree
+        with pytest.raises(FSError):
+            await cl.set_subtree_pin("/pinned", 7)
+        assert m0.auth_rank("/pinned") == 0
+        await c.stop()
+
+    run(t())
+
+
 def test_export_crash_replay():
     async def t():
         c, (m0, m1), cl = await make()

@@ -330,3 +330,49 @@ def test_multiprocess_mds_kill9_replay(tmp_path):
             await c.stop()
 
     run(t())
+
+
+def test_multiprocess_multimds_pin_and_cross_rename(tmp_path):
+    """TWO MDS ranks as separate OS processes: a client pins a subtree
+    to rank 1 (the ceph.dir.pin role), redirects route over real
+    sockets, and a cross-subtree rename runs its peer-request link
+    half between the two daemon processes."""
+    async def t():
+        from ceph_tpu.services.fs import FSLite
+        from ceph_tpu.services.mds import FSClient
+
+        c = await make(tmp_path)
+        try:
+            await FSLite(c.client, 1).mkfs()
+            await c.start_mds(0, pool=1)
+            await c.start_mds(1, pool=1)
+            fs = FSClient(c.bus, c.client, 1, name="fsclient.0",
+                          timeout=30.0)
+            await fs.connect()
+            await fs.mkdir("/a")
+            await fs.mkdir("/b")
+            await fs.set_subtree_pin("/b", 1)
+            # ops in both subtrees, including a cold client whose map
+            # says rank 0 for everything
+            await fs.create("/b/owned-by-1")
+            await fs.write("/b/owned-by-1", b"rank1 data")
+            fs2 = FSClient(c.bus, c.client, 1, name="fsclient.1",
+                           timeout=30.0)
+            await fs2.connect()
+            assert await fs2.read("/b/owned-by-1") == b"rank1 data"
+            # cross-subtree rename: peer_link travels mds.0 -> mds.1
+            # over a kernel socket
+            await fs.create("/a/f")
+            await fs.write("/a/f", b"crossing")
+            await fs.rename("/a/f", "/b/f")
+            assert await fs2.read("/b/f") == b"crossing"
+            assert await fs2.listdir("/a") == []
+            # and back the other way (mds.1 -> mds.0)
+            await fs2.rename("/b/f", "/a/back")
+            assert await fs.read("/a/back") == b"crossing"
+            await fs.close()
+            await fs2.close()
+        finally:
+            await c.stop()
+
+    run(t())
