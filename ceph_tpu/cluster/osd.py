@@ -383,6 +383,49 @@ class OSDLite:
             except Exception:
                 self.log_exc("op worker")
 
+    async def _bench(self, count: int, size: int) -> dict:
+        """Raw local-store write throughput, bypassing the cluster
+        data path (the `ceph tell osd.N bench` role): N objects of
+        ``size`` bytes into a scratch collection, removed afterwards.
+        Size is clamped like osd_bench_max_block_size — an admin typo
+        must not OOM the daemon. The scratch cid is unique per
+        invocation and torn down in ``finally``, so a mid-loop store
+        error (or a concurrent bench) cannot leak it or wedge later
+        runs."""
+        import time as _time
+
+        size = max(1, min(size, 4 << 20))
+        count = max(1, min(count, 1024))
+        cid = f"bench.{self.id}.{_time.monotonic_ns()}"
+        blob = os.urandom(size)
+        loop = asyncio.get_running_loop()
+        t = tx_mod.Transaction()
+        t.create_collection(cid)
+        self.store.queue_transaction(t)
+        written = 0
+        try:
+            t0 = _time.perf_counter()
+            for i in range(count):
+                t = tx_mod.Transaction()
+                t.write(cid, b"bench.%d" % i, 0, blob)
+                done = loop.create_future()
+                self.store.queue_transaction(
+                    t, lambda f=done: loop.call_soon_threadsafe(
+                        lambda: f.done() or f.set_result(None)))
+                await done
+                written += 1
+            dt = _time.perf_counter() - t0
+        finally:
+            t = tx_mod.Transaction()
+            for i in range(written):
+                t.remove(cid, b"bench.%d" % i)
+            t.remove_collection(cid)
+            self.store.queue_transaction(t)
+        return {"bytes_written": count * size, "blocksize": size,
+                "elapsed_sec": round(dt, 6),
+                "bytes_per_sec": round(count * size / dt, 1),
+                "iops": round(count / dt, 1)}
+
     async def start_admin(self, path: str) -> None:
         """Expose the daemon on an admin socket (`ceph daemon` role)."""
         sock = AdminSocket(path)
@@ -422,6 +465,13 @@ class OSDLite:
                 int(a.get("limit", 20))
             ),
             "recently completed ops with event timelines",
+        )
+        sock.register(
+            "bench",
+            lambda a: self._bench(int(a.get("count", 16)),
+                                  int(a.get("size", 1 << 20))),
+            "raw store write bench: {count, size<=4MiB} "
+            "(`ceph tell osd.N bench` role, OSD.cc:3302)",
         )
         sock.register(
             "dump_tracing",

@@ -229,3 +229,26 @@ def test_dashboard_module():
         await c.stop()
 
     run(t())
+
+
+def test_osd_bench_admin_command(tmp_path):
+    """`ceph tell osd.N bench` role: raw store write throughput via
+    the admin socket, scratch state cleaned up."""
+    async def t():
+        c = await make()
+        osd = c.osds[0]
+        await osd.start_admin(str(tmp_path / "osd.sock"))
+        out = await admin_command(osd.admin.path, "bench",
+                                  count=8, size=65536)
+        assert out["bytes_written"] == 8 * 65536
+        assert out["bytes_per_sec"] > 0 and out["iops"] > 0
+        # scratch collection removed (unique per-invocation cid)
+        assert not [cid for cid in osd.store.list_collections()
+                    if str(cid).startswith(f"bench.{osd.id}")]
+        # size clamp: an absurd request is bounded, not fatal
+        out = await admin_command(osd.admin.path, "bench",
+                                  count=2, size=1 << 30)
+        assert out["blocksize"] == 4 << 20
+        await c.stop()
+
+    run(t())
