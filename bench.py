@@ -520,8 +520,12 @@ def config6_rados_bench(latency: float) -> dict:
         # vs host EC engine economics (ec/engine.py) — over this
         # ~10 MiB/s tunnel the C++ host core wins; on a chip-local
         # link the device batch path wins and is picked instead.
+        # pg_num 32: ops serialize per-PG (the reference's ordering
+        # contract), so PG count IS the op-level parallelism; 8 PGs
+        # under-filled even one reactor core (~20% measured loss).
+        # Real deployments run >=128 PGs on 12 OSDs.
         await c.client.create_pool(Pool(
-            id=2, name="bench", size=11, min_size=9, pg_num=8,
+            id=2, name="bench", size=11, min_size=9, pg_num=32,
             crush_rule=1, type="erasure",
             ec_profile={"plugin": "rs_tpu", "k": "8", "m": "3",
                         "stripe_unit": "65536"}))

@@ -142,7 +142,10 @@ def test_paxos_mons_restart_with_quorum(tmp_path):
         await c.start()
         await c.client.create_pool(
             Pool(id=1, name="rep", size=3, pg_num=4, crush_rule=0))
-        await c.wait_active(20)
+        # generous: paxos elections + peering on a loaded single-core
+        # box can take far longer than the idle-box 3 s (this test
+        # flaked at ~1/3 full-suite runs with tighter budgets)
+        await c.wait_active(60)
         await c.client.write_full(1, "obj", b"paxos-durable" * 50)
         saved["epoch"] = c.mon.osdmap.epoch
         await c.stop()
@@ -153,7 +156,7 @@ def test_paxos_mons_restart_with_quorum(tmp_path):
         await c.start()  # waits for quorum
         assert c.mon.osdmap.epoch >= saved["epoch"]
         assert 1 in c.mon.osdmap.pools
-        await c.wait_active(30)
+        await c.wait_active(60)
         assert await c.client.read(1, "obj") == b"paxos-durable" * 50
         # the recovered cluster still takes writes
         await c.client.write_full(1, "obj2", b"new")
