@@ -30,6 +30,7 @@ import xml.etree.ElementTree as ET
 from ..osdc.striper import FileLayout
 from ..osdc.striped_client import RadosStriper
 from ..utils import denc
+from . import rgw_acl
 
 ROOT_OID = b".rgw.root"
 STRIPE_THRESHOLD = 1 << 22  # larger objects stripe
@@ -190,20 +191,26 @@ def _null_order(mtime: float) -> str:
 def _enc_entry(size: int, etag: str, mtime: float,
                multipart: bool = False, vid: str = "",
                marker: bool = False, ctype: str = "",
-               meta: dict[str, str] | None = None) -> bytes:
+               meta: dict[str, str] | None = None,
+               owner: str = "", acl: str = "") -> bytes:
     """Index entry: size/etag/mtime/multipart plus the versioning
     fields (rgw_bucket_dir_entry role): ``vid`` names the version the
     entry points at ("" = unversioned/null version at the plain data
     oid) and ``marker`` flags an S3 delete marker. ``ctype``/``meta``
     carry the content type and user metadata (x-amz-meta-* /
     X-Object-Meta-* — the rgw attrs role, indexed so HEAD/listings
-    never touch the data objects)."""
+    never touch the data objects).  ``owner``/``acl`` are the
+    per-object access-control policy (rgw_acl.h ACLOwner role; see
+    services/rgw_acl.py).  Tail stages are positional: a stage is
+    emitted whenever it or any LATER stage carries data."""
     out = (denc.enc_u64(size) + denc.enc_str(etag)
            + denc.enc_u64(int(mtime)) + denc.enc_u8(multipart)
            + denc.enc_str(vid) + denc.enc_u8(marker))
-    if ctype or meta:
+    if ctype or meta or owner or acl:
         out += denc.enc_str(ctype) + denc.enc_map(
             meta or {}, denc.enc_str, denc.enc_str)
+    if owner or acl:
+        out += denc.enc_str(owner) + denc.enc_str(acl)
     return out
 
 
@@ -213,16 +220,20 @@ def _dec_entry(b: bytes) -> dict:
     mtime, off = denc.dec_u64(b, off)
     multipart, off = denc.dec_u8(b, off)
     vid, marker, ctype, meta = "", 0, "", {}
+    owner, acl = "", ""
     if off < len(b):  # entries written before versioning lack these
         vid, off = denc.dec_str(b, off)
         marker, off = denc.dec_u8(b, off)
     if off < len(b):  # and older ones lack the attrs tail
         ctype, off = denc.dec_str(b, off)
         meta, off = denc.dec_map(b, off, denc.dec_str, denc.dec_str)
+    if off < len(b):  # and older still lack the acl tail
+        owner, off = denc.dec_str(b, off)
+        acl, off = denc.dec_str(b, off)
     return {"size": size, "etag": etag, "mtime": mtime,
             "multipart": bool(multipart), "version_id": vid,
             "delete_marker": bool(marker), "content_type": ctype,
-            "meta": meta}
+            "meta": meta, "owner": owner, "acl": acl}
 
 
 DATALOG_OID = b".rgw.datalog"
@@ -394,7 +405,8 @@ class RGWLite:
 
     # ------------------------------------------------------------ buckets
 
-    async def create_bucket(self, bucket: str) -> None:
+    async def create_bucket(self, bucket: str, owner: str = "",
+                            acl: str = "") -> None:
         if not bucket or "/" in bucket:
             raise RGWError("InvalidBucketName")
         existing = await self._buckets()
@@ -407,6 +419,8 @@ class RGWLite:
         )
         await self.client.write_full(self.pool_id, _index_oid(bucket),
                                      b"")
+        if owner or acl:
+            await self.put_bucket_acl(bucket, owner, acl)
 
     async def delete_bucket(self, bucket: str) -> None:
         await self._require_bucket(bucket)
@@ -470,6 +484,110 @@ class RGWLite:
     async def _versioning_enabled(self, bucket: str) -> bool:
         return await self.get_bucket_versioning(bucket) == "Enabled"
 
+    # ------------------------------------------------------ access control
+
+    ATTR_OWNER = "rgw.owner"
+    ATTR_ACL = "rgw.acl"
+
+    async def put_bucket_acl(self, bucket: str, owner: str,
+                             acl: str) -> None:
+        """Set bucket owner + grant list (rgw_acl_s3.cc policy-attr
+        role; grant-list text format per services/rgw_acl.py)."""
+        await self._require_bucket(bucket)
+        await self._log_bucket(bucket)
+        oid = _index_oid(bucket)
+        await self.client.setxattr(self.pool_id, oid,
+                                   self.ATTR_OWNER, owner.encode())
+        await self.client.setxattr(self.pool_id, oid,
+                                   self.ATTR_ACL, acl.encode())
+
+    async def _bucket_xattr(self, bucket: str, attr: str) -> str:
+        try:
+            raw = await self.client.getxattr(
+                self.pool_id, _index_oid(bucket), attr)
+        except (KeyError, IOError):
+            raw = b""
+        return raw.decode()
+
+    async def bucket_owner(self, bucket: str) -> str:
+        """Owner xattr only, no existence re-check — for callers that
+        already hold the bucket name from a listing."""
+        return await self._bucket_xattr(bucket, self.ATTR_OWNER)
+
+    async def get_bucket_acl(self, bucket: str) -> tuple[str, str]:
+        """Returns (owner, grant-list text); ("", "") when never set
+        (open / pre-ACL bucket).  One batched xattr fetch — this sits
+        on every authorized request's path."""
+        await self._require_bucket(bucket)
+        try:
+            xattrs = await self.client.getxattrs(
+                self.pool_id, _index_oid(bucket))
+        except (KeyError, IOError):
+            xattrs = {}
+        return (xattrs.get(self.ATTR_OWNER, b"").decode(),
+                xattrs.get(self.ATTR_ACL, b"").decode())
+
+    async def put_object_acl(self, bucket: str, key: str, owner: str,
+                             acl: str, version_id: str = "",
+                             _ent: dict | None = None) -> None:
+        """Rewrite the index entry's acl tail (RGWPutACLs role).  On a
+        versioned bucket with an explicit version_id the named version
+        row is updated; the bucket's CURRENT pointer is rewritten only
+        when the named version actually is the current one (naming a
+        historical version must never resurrect its data as current —
+        round-5 review finding)."""
+        ent = (_ent if _ent is not None
+               else await self.head_object(bucket, key, version_id))
+        row = _enc_entry(ent["size"], ent["etag"], ent["mtime"],
+                         multipart=ent["multipart"],
+                         vid=ent["version_id"],
+                         marker=ent["delete_marker"],
+                         ctype=ent["content_type"], meta=ent["meta"],
+                         owner=owner, acl=acl)
+        vid = ent["version_id"]
+        try:
+            cur = await self.index.get(bucket, key)
+        except RGWError:
+            cur = None
+        if vid == "null":
+            # the preserved pre-versioning object: current when the
+            # plain entry is still the un-versioned one (whose row
+            # must KEEP vid="" — writing "null" there would corrupt
+            # the current pointer), otherwise a preserved
+            # mtime-ordered row
+            if cur is not None and not cur["version_id"] \
+                    and not cur["delete_marker"]:
+                await self.index.put(
+                    bucket, key,
+                    _enc_entry(ent["size"], ent["etag"],
+                               ent["mtime"],
+                               multipart=ent["multipart"],
+                               ctype=ent["content_type"],
+                               meta=ent["meta"], owner=owner,
+                               acl=acl))
+            else:
+                await self.index.put(
+                    bucket,
+                    _ver_index_key(key, _null_order(ent["mtime"])),
+                    row)
+            return
+        if vid:
+            await self.index.put(bucket, _ver_index_key(key, vid),
+                                 row)
+            if cur is not None and cur["version_id"] == vid:
+                await self.index.put(bucket, key, row)
+            return
+        await self.index.put(bucket, key, row)
+
+    async def get_object_acl(self, bucket: str, key: str,
+                             version_id: str = "") -> tuple[str, str]:
+        """Returns the object's (owner, grants); falls back to the
+        BUCKET policy when the entry predates ACLs (legacy rows)."""
+        ent = await self.head_object(bucket, key, version_id)
+        if ent["owner"] or ent["acl"]:
+            return ent["owner"], ent["acl"]
+        return await self.get_bucket_acl(bucket)
+
     async def list_object_versions(self, bucket: str, prefix: str = "",
                                    max_keys: int = 1000) -> list[dict]:
         """All versions + delete markers, newest first per key
@@ -509,6 +627,7 @@ class RGWLite:
     async def put_object(self, bucket: str, key: str, data: bytes,
                          content_type: str = "",
                          meta: dict[str, str] | None = None,
+                         owner: str = "", acl: str = "",
                          _event: str = "s3:ObjectCreated:Put"
                          ) -> str | tuple[str, str]:
         """Returns the etag; on a versioning-enabled bucket returns
@@ -527,7 +646,8 @@ class RGWLite:
             await self.client.write_full(
                 self.pool_id, _ver_oid(bucket, key, vid), data)
             entry = _enc_entry(len(data), etag, now, vid=vid,
-                               ctype=content_type, meta=meta)
+                               ctype=content_type, meta=meta,
+                               owner=owner, acl=acl)
             # the version row, then the current pointer
             await self.index.put(bucket, _ver_index_key(key, vid),
                                  entry)
@@ -543,7 +663,8 @@ class RGWLite:
             await self.client.write_full(self.pool_id, oid, data)
         await self.index.put(bucket, key,
                              _enc_entry(len(data), etag, time.time(),
-                                        ctype=content_type, meta=meta))
+                                        ctype=content_type, meta=meta,
+                                        owner=owner, acl=acl))
         await self._notify(bucket, key, _event, size=len(data),
                            etag=etag)
         return etag
@@ -572,14 +693,18 @@ class RGWLite:
             return  # already versioned / already preserved
         row = _enc_entry(cur["size"], cur["etag"], cur["mtime"],
                          multipart=cur["multipart"], vid="null",
-                         ctype=cur["content_type"], meta=cur["meta"])
+                         ctype=cur["content_type"], meta=cur["meta"],
+                         owner=cur["owner"], acl=cur["acl"])
         await self.index.put(
             bucket, _ver_index_key(key, _null_order(cur["mtime"])),
             row)
 
     async def get_object(self, bucket: str, key: str,
-                         version_id: str = "") -> tuple[bytes, dict]:
-        meta = await self.head_object(bucket, key, version_id)
+                         version_id: str = "",
+                         _meta: dict | None = None
+                         ) -> tuple[bytes, dict]:
+        meta = (_meta if _meta is not None
+                else await self.head_object(bucket, key, version_id))
         if meta["delete_marker"]:
             raise RGWError("NoSuchKey", 404)  # named marker version
         if meta["version_id"] and meta["version_id"] != "null":
@@ -740,20 +865,25 @@ class RGWLite:
                            vid=ent["version_id"],
                            marker=ent["delete_marker"],
                            ctype=ent["content_type"],
-                           meta=ent["meta"]))
+                           meta=ent["meta"], owner=ent["owner"],
+                           acl=ent["acl"]))
         else:
             await self.index.delete(bucket, key)
 
     async def copy_object(self, src_bucket: str, src_key: str,
                           dst_bucket: str, dst_key: str,
-                          meta: dict[str, str] | None = None) -> str:
+                          meta: dict[str, str] | None = None,
+                          owner: str = "", acl: str = "") -> str:
         """Server-side copy; source attrs carry over unless ``meta``
-        replaces them (x-amz-metadata-directive REPLACE role)."""
+        replaces them (x-amz-metadata-directive REPLACE role).  The
+        ACL does NOT carry over — like S3, the copy is a fresh write
+        owned by the copier."""
         data, src = await self.get_object(src_bucket, src_key)
         return await self.put_object(
             dst_bucket, dst_key, data,
             content_type=src["content_type"],
             meta=src["meta"] if meta is None else meta,
+            owner=owner, acl=acl,
             _event="s3:ObjectCreated:Copy")
 
     async def list_objects(self, bucket: str, prefix: str = "",
@@ -1082,8 +1212,11 @@ class S3Frontend(HttpFrontend):
         self._now = None
 
     def _authenticate(self, method: str, target: str, headers: dict,
-                      body: bytes) -> str | None:
-        """Validate sigv4; returns an S3 error code or None (ok)."""
+                      body: bytes) -> tuple[str | None, str | None]:
+        """Validate sigv4; returns (error-code | None, principal).
+        A request carrying NO signature at all is not an error — it is
+        the ANONYMOUS principal (None), and the ACL layer decides what
+        anonymous may touch (rgw_auth.cc anonymous-engine role)."""
         # presigned dispatch keys on the ACTUAL query parameter, not a
         # substring — an object key may legally contain the literal
         # text "X-Amz-Signature=" (round-5 review finding)
@@ -1094,8 +1227,10 @@ class S3Frontend(HttpFrontend):
             return self._authenticate_presigned(method, target,
                                                 headers)
         auth = headers.get("authorization", "")
+        if not auth:
+            return None, None  # anonymous
         if not auth.startswith("AWS4-HMAC-SHA256 "):
-            return "AccessDenied"
+            return "AccessDenied", None
         try:
             fields = dict(
                 kv.strip().split("=", 1)
@@ -1105,26 +1240,26 @@ class S3Frontend(HttpFrontend):
             signed = fields["SignedHeaders"].split(";")
             given_sig = fields["Signature"]
         except (KeyError, IndexError, ValueError):
-            return "AuthorizationHeaderMalformed"
+            return "AuthorizationHeaderMalformed", None
         secret = self.users.get(access)
         if secret is None:
-            return "InvalidAccessKeyId"
+            return "InvalidAccessKeyId", None
         amz_date = headers.get("x-amz-date", "")
         if not amz_date.startswith(date):
-            return "SignatureDoesNotMatch"
+            return "SignatureDoesNotMatch", None
         # request freshness: reject timestamps outside the skew window
         try:
             ts = calendar.timegm(
                 time.strptime(amz_date, "%Y%m%dT%H%M%SZ"))
         except ValueError:
-            return "AuthorizationHeaderMalformed"
+            return "AuthorizationHeaderMalformed", None
         now = self._now if self._now is not None else time.time()
         if abs(now - ts) > self.CLOCK_SKEW_S:
-            return "RequestTimeTooSkewed"
+            return "RequestTimeTooSkewed", None
         # content hash must match the body (payload integrity)
         want_hash = headers.get("x-amz-content-sha256", "")
         if want_hash not in ("UNSIGNED-PAYLOAD", _sha256(body)):
-            return "XAmzContentSHA256Mismatch"
+            return "XAmzContentSHA256Mismatch", None
         parsed = urllib.parse.urlsplit(target)
         payload_hash = (want_hash if want_hash else _sha256(body))
         canon = sigv4_canonical_request(
@@ -1132,11 +1267,12 @@ class S3Frontend(HttpFrontend):
             headers, signed, payload_hash)
         sig = sigv4_signature(secret, date, region, amz_date, canon)
         if not _hmac.compare_digest(sig, given_sig):
-            return "SignatureDoesNotMatch"
-        return None
+            return "SignatureDoesNotMatch", None
+        return None, access
 
-    def _authenticate_presigned(self, method: str, target: str,
-                                headers: dict) -> str | None:
+    def _authenticate_presigned(
+            self, method: str, target: str,
+            headers: dict) -> tuple[str | None, str | None]:
         """Query-string sigv4 (presigned URLs): the signature lives in
         the query, the payload is UNSIGNED, and the expiry window is
         part of the signed material — a tampered X-Amz-Expires fails
@@ -1146,24 +1282,24 @@ class S3Frontend(HttpFrontend):
                                        keep_blank_values=True)
         qd = dict(pairs)
         if qd.get("X-Amz-Algorithm") != "AWS4-HMAC-SHA256":
-            return "AuthorizationHeaderMalformed"
+            return "AuthorizationHeaderMalformed", None
         cred = qd.get("X-Amz-Credential", "").split("/")
         if len(cred) < 3:
-            return "AuthorizationHeaderMalformed"
+            return "AuthorizationHeaderMalformed", None
         access, date, region = cred[0], cred[1], cred[2]
         secret = self.users.get(access)
         if secret is None:
-            return "InvalidAccessKeyId"
+            return "InvalidAccessKeyId", None
         amz_date = qd.get("X-Amz-Date", "")
         try:
             ts = calendar.timegm(
                 time.strptime(amz_date, "%Y%m%dT%H%M%SZ"))
             expires = int(qd.get("X-Amz-Expires", "0"))
         except ValueError:
-            return "AuthorizationHeaderMalformed"
+            return "AuthorizationHeaderMalformed", None
         now = self._now if self._now is not None else time.time()
         if now > ts + expires or ts - now > self.CLOCK_SKEW_S:
-            return "AccessDenied"  # expired (or from the future)
+            return "AccessDenied", None  # expired / from the future
         signed = qd.get("X-Amz-SignedHeaders", "host").split(";")
         # canonical query = every param EXCEPT the signature itself
         q = urllib.parse.urlencode(
@@ -1175,21 +1311,95 @@ class S3Frontend(HttpFrontend):
         sig = sigv4_signature(secret, date, region, amz_date, canon)
         if not _hmac.compare_digest(sig,
                                     qd.get("X-Amz-Signature", "")):
-            return "SignatureDoesNotMatch"
-        return None
+            return "SignatureDoesNotMatch", None
+        return None, access
 
     async def _handle(self, method: str, target: str, headers: dict,
                       body: bytes) -> tuple[int, dict, bytes]:
-        err = (self._authenticate(method, target, headers, body)
-               if self.users else None)
+        err, principal = (
+            self._authenticate(method, target, headers, body)
+            if self.users else (None, None))
         if err is not None:
             el = ET.Element("Error")
             ET.SubElement(el, "Code").text = err
             return 403, {"content-type": "application/xml"}, _xml(el)
-        return await self._route(method, target, headers, body)
+        return await self._route(method, target, headers, body,
+                                 principal)
+
+    # ------------------------------------------------------ authorization
+    #
+    # rgw_op.cc verify_bucket/object_permission role.  Enforcement is
+    # active only when a user table exists; the open (DummyAuth)
+    # frontend stays fully permissive.
+
+    def _enforce(self, acl: "rgw_acl.Acl", principal: str | None,
+                 perm: str) -> None:
+        """The ONE owner of the "is enforcement on" rule: no user
+        table = permissive.  The `if self.users` in _authz_* is purely
+        a policy-FETCH skip, never the decision."""
+        if self.users and not acl.allows(principal, perm):
+            raise RGWError("AccessDenied", 403)
+
+    async def _bucket_policy(self, bucket: str) -> "rgw_acl.Acl":
+        owner, text = await self.rgw.get_bucket_acl(bucket)
+        return rgw_acl.Acl.parse(owner, text)
+
+    async def _authz_bucket(self, bucket: str, principal: str | None,
+                            perm: str) -> None:
+        if self.users:
+            self._enforce(await self._bucket_policy(bucket),
+                          principal, perm)
+
+    async def _head_guarded(self, bucket: str, key: str, vid: str,
+                            principal: str | None) -> dict:
+        """head_object with the S3 404-vs-403 rule: a key's ABSENCE
+        (or an unknown version) is disclosed only to principals
+        holding READ (list) on the bucket — everyone else gets
+        AccessDenied, closing the key-existence oracle the anonymous
+        path would otherwise open (round-5 review finding)."""
+        try:
+            return await self.rgw.head_object(bucket, key,
+                                              version_id=vid)
+        except RGWError as e:
+            if self.users and e.status == 404 \
+                    and e.code != "NoSuchBucket":
+                self._enforce(await self._bucket_policy(bucket),
+                              principal, "READ")
+            raise
+
+    async def _authz_object(self, bucket: str, key: str, vid: str,
+                            principal: str | None,
+                            perm: str) -> dict | None:
+        """Guarded head + enforce; returns the fetched entry so the
+        caller can reuse it (one index round trip per request)."""
+        if not self.users:
+            return None
+        meta = await self._head_guarded(bucket, key, vid, principal)
+        self._enforce(await self._policy_of(bucket, meta),
+                      principal, perm)
+        return meta
+
+    async def _policy_of(self, bucket: str,
+                         ent: dict) -> "rgw_acl.Acl":
+        """Policy from an already-fetched index entry (no second
+        index round trip on the read path), bucket fallback for
+        pre-ACL rows."""
+        if ent["owner"] or ent["acl"]:
+            return rgw_acl.Acl.parse(ent["owner"], ent["acl"])
+        return await self._bucket_policy(bucket)
+
+    def _canned_grants(self, headers: dict,
+                       principal: str | None) -> str:
+        """Expand an x-amz-acl header into grant-list text (canned-ACL
+        role); absent header = private."""
+        name = headers.get("x-amz-acl", "") or "private"
+        try:
+            return rgw_acl.Acl.canned(principal or "", name).dump()
+        except KeyError:
+            raise RGWError("InvalidArgument") from None
 
     async def _route(self, method: str, target: str, headers: dict,
-                     body: bytes):
+                     body: bytes, principal: str | None = None):
         parsed = urllib.parse.urlsplit(target)
         path = urllib.parse.unquote(parsed.path)
         query = urllib.parse.parse_qs(parsed.query,
@@ -1198,37 +1408,72 @@ class S3Frontend(HttpFrontend):
         try:
             if not parts:
                 if method == "GET":
-                    return await self._list_buckets()
+                    if self.users and principal is None:
+                        # S3 ListBuckets is per-account; anonymous
+                        # gets nothing (round-5 review finding)
+                        raise RGWError("AccessDenied", 403)
+                    return await self._list_buckets(principal)
                 return 400, {}, b""
             bucket = parts[0]
             key = "/".join(parts[1:])
             if not key:
+                if "acl" in query:
+                    return await self._bucket_acl_route(
+                        method, bucket, headers, body, principal)
                 if "versioning" in query:
+                    await self._authz_bucket(
+                        bucket, principal,
+                        "FULL_CONTROL" if method == "PUT" else "READ")
                     return await self._bucket_versioning(
                         method, bucket, body)
                 if "lifecycle" in query:
+                    await self._authz_bucket(
+                        bucket, principal,
+                        "FULL_CONTROL" if method == "PUT" else "READ")
                     return await self._bucket_lifecycle(
                         method, bucket, body)
                 if "versions" in query:
+                    await self._authz_bucket(bucket, principal,
+                                             "READ")
                     return await self._list_versions(bucket, query)
                 if method == "PUT":
-                    await self.rgw.create_bucket(bucket)
+                    if self.users and principal is None:
+                        # anonymous principals never own buckets
+                        raise RGWError("AccessDenied", 403)
+                    await self.rgw.create_bucket(
+                        bucket, owner=principal or "",
+                        acl=self._canned_grants(headers, principal))
                     return 200, {}, b""
                 if method == "DELETE":
+                    await self._authz_bucket(bucket, principal,
+                                             "FULL_CONTROL")
                     await self.rgw.delete_bucket(bucket)
                     return 204, {}, b""
                 if method == "GET":
+                    await self._authz_bucket(bucket, principal,
+                                             "READ")
                     return await self._list_objects(bucket, query)
                 return 400, {}, b""
             vid = query.get("versionId", [""])[0]
+            if "acl" in query:
+                return await self._object_acl_route(
+                    method, bucket, key, vid, headers, body,
+                    principal)
             if method == "PUT":
+                await self._authz_bucket(bucket, principal, "WRITE")
+                grants = self._canned_grants(headers, principal)
                 src = headers.get("x-amz-copy-source")
                 if src:
                     sb, _, sk = src.strip("/").partition("/")
-                    etag = await self.rgw.copy_object(sb, sk, bucket,
-                                                      key)
+                    await self._authz_object(sb, sk, "", principal,
+                                             "READ")
+                    etag = await self.rgw.copy_object(
+                        sb, sk, bucket, key,
+                        owner=principal or "", acl=grants)
                 else:
-                    etag = await self.rgw.put_object(bucket, key, body)
+                    etag = await self.rgw.put_object(
+                        bucket, key, body,
+                        owner=principal or "", acl=grants)
                 rh = {}
                 if isinstance(etag, tuple):
                     etag, new_vid = etag
@@ -1236,20 +1481,26 @@ class S3Frontend(HttpFrontend):
                 rh["etag"] = f'"{etag}"'
                 return 200, rh, b""
             if method == "GET":
+                meta = await self._authz_object(bucket, key, vid,
+                                                principal, "READ")
                 data, meta = await self.rgw.get_object(
-                    bucket, key, version_id=vid)
+                    bucket, key, version_id=vid, _meta=meta)
                 rh = {"etag": f'"{meta["etag"]}"'}
                 if meta["version_id"]:
                     rh["x-amz-version-id"] = meta["version_id"]
                 return 200, rh, data
             if method == "HEAD":
-                meta = await self.rgw.head_object(bucket, key,
-                                                  version_id=vid)
+                meta = await self._authz_object(bucket, key, vid,
+                                                principal, "READ")
+                if meta is None:  # open frontend: fetch for headers
+                    meta = await self.rgw.head_object(
+                        bucket, key, version_id=vid)
                 return 200, {
                     "etag": f'"{meta["etag"]}"',
                     "content-length": str(meta["size"]),
                 }, b""
             if method == "DELETE":
+                await self._authz_bucket(bucket, principal, "WRITE")
                 marker_vid = await self.rgw.delete_object(
                     bucket, key, version_id=vid)
                 rh = {}
@@ -1264,6 +1515,59 @@ class S3Frontend(HttpFrontend):
             ET.SubElement(err, "Code").text = e.code
             return e.status, {"content-type": "application/xml"}, \
                 _xml(err)
+
+    async def _acl_route(self, method: str, headers: dict,
+                         body: bytes, principal: str | None,
+                         policy: "rgw_acl.Acl", store):
+        """Shared GET/PUT ?acl machinery (RGWGetACLs / RGWPutACLs
+        role) for buckets AND objects — ``policy`` is the current
+        policy, ``store`` persists a new grant list.  The owner is
+        immutable — a PUT replaces only the grant list, from either an
+        XML AccessControlPolicy body or an x-amz-acl canned header.
+        A body that does not parse as a policy is a 400
+        MalformedACLError, never a dropped connection or a silently
+        thinned grant list."""
+        if method == "GET":
+            self._enforce(policy, principal, "READ_ACP")
+            return 200, {"content-type": "application/xml"}, \
+                policy.to_xml()
+        if method != "PUT":
+            return 400, {}, b""
+        self._enforce(policy, principal, "WRITE_ACP")
+        if body:
+            try:
+                grants = rgw_acl.Acl.from_xml(
+                    body, policy.owner).dump()
+            except (ET.ParseError, ValueError):
+                raise RGWError("MalformedACLError") from None
+        else:
+            grants = self._canned_grants(headers, principal)
+        await store(policy.owner, grants)
+        return 200, {}, b""
+
+    async def _bucket_acl_route(self, method: str, bucket: str,
+                                headers: dict, body: bytes,
+                                principal: str | None):
+        policy = await self._bucket_policy(bucket)
+
+        async def store(owner, grants):
+            await self.rgw.put_bucket_acl(bucket, owner, grants)
+
+        return await self._acl_route(method, headers, body, principal,
+                                     policy, store)
+
+    async def _object_acl_route(self, method: str, bucket: str,
+                                key: str, vid: str, headers: dict,
+                                body: bytes, principal: str | None):
+        meta = await self._head_guarded(bucket, key, vid, principal)
+        policy = await self._policy_of(bucket, meta)
+
+        async def store(owner, grants):
+            await self.rgw.put_object_acl(bucket, key, owner, grants,
+                                          version_id=vid, _ent=meta)
+
+        return await self._acl_route(method, headers, body, principal,
+                                     policy, store)
 
     async def _bucket_versioning(self, method: str, bucket: str,
                                  body: bytes):
@@ -1330,10 +1634,21 @@ class S3Frontend(HttpFrontend):
                 ET.SubElement(el, "ETag").text = f'"{e["etag"]}"'
         return 200, {"content-type": "application/xml"}, _xml(root)
 
-    async def _list_buckets(self):
+    async def _list_buckets(self, principal: str | None = None):
+        """ListBuckets is per-account: only the principal's own
+        buckets (plus ownerless pre-ACL ones) appear when a user
+        table is configured.  Owners come from one CONCURRENT xattr
+        sweep — no per-bucket re-fetch of the bucket registry
+        (round-5 review finding)."""
+        names = await self.rgw.list_buckets()
+        owners = ([""] * len(names) if not self.users else
+                  await asyncio.gather(
+                      *(self.rgw.bucket_owner(b) for b in names)))
         root = ET.Element("ListAllMyBucketsResult")
         buckets = ET.SubElement(root, "Buckets")
-        for b in await self.rgw.list_buckets():
+        for b, owner in zip(names, owners):
+            if self.users and owner and owner != principal:
+                continue
             el = ET.SubElement(buckets, "Bucket")
             ET.SubElement(el, "Name").text = b
         return 200, {"content-type": "application/xml"}, _xml(root)

@@ -235,6 +235,10 @@ class RGWSyncAgent:
             lc = await self.src.get_lifecycle(bucket)
             if lc != await self.dst.get_lifecycle(bucket):
                 await self.dst.put_lifecycle(bucket, lc)
+            pol = await self.src.get_bucket_acl(bucket)
+            if pol != ("", "") and \
+                    pol != await self.dst.get_bucket_acl(bucket):
+                await self.dst.put_bucket_acl(bucket, *pol)
         elif dst_has:
             # src deleted it (which required empty): the source is
             # authoritative, purge everything local and drop the bucket
@@ -289,7 +293,8 @@ class RGWSyncAgent:
         (content-type, x-amz-meta, mtime) must replicate even when the
         bytes are unchanged (round-5 review finding)."""
         return (ent["etag"], ent["size"], ent["mtime"],
-                ent["content_type"], ent["meta"])
+                ent["content_type"], ent["meta"],
+                ent.get("owner", ""), ent.get("acl", ""))
 
     async def _reconcile_plain(self, bucket: str, key: str) -> None:
         src_ent = await self._current(self.src, bucket, key)
@@ -322,7 +327,9 @@ class RGWSyncAgent:
             bucket, key,
             _enc_entry(ent["size"], ent["etag"], ent["mtime"],
                        vid=ent.get("version_id", ""),
-                       ctype=ent["content_type"], meta=ent["meta"]))
+                       ctype=ent["content_type"], meta=ent["meta"],
+                       owner=ent.get("owner", ""),
+                       acl=ent.get("acl", "")))
 
     # ----------------------------------------- versioned key reconcile
 
@@ -353,6 +360,14 @@ class RGWSyncAgent:
                             reverse=True):  # oldest first
             await self._copy_version(bucket, key, order,
                                      src_rows[order])
+        # rows present on BOTH sides can still differ in place (an
+        # ACL/metadata rewrite of an existing version row): re-copy on
+        # signature mismatch (round-5 review finding)
+        for order in src_rows.keys() & dst_rows.keys():
+            if self._ent_sig(src_rows[order]) != \
+                    self._ent_sig(dst_rows[order]):
+                await self._copy_version(bucket, key, order,
+                                         src_rows[order])
         for order in sorted(dst_rows.keys() - src_rows.keys()):
             ent = dst_rows[order]
             if (not ent["delete_marker"]
@@ -380,7 +395,9 @@ class RGWSyncAgent:
             # landed assembled even if the source null was multipart
             row = _enc_entry(ent["size"], ent["etag"], ent["mtime"],
                              vid="null", ctype=ent["content_type"],
-                             meta=ent["meta"])
+                             meta=ent["meta"],
+                             owner=ent.get("owner", ""),
+                             acl=ent.get("acl", ""))
         else:
             try:
                 data = await self.src.client.read(
@@ -391,7 +408,9 @@ class RGWSyncAgent:
                 self.dst.pool_id, _ver_oid(bucket, key, vid), data)
             row = _enc_entry(len(data), ent["etag"], ent["mtime"],
                              vid=vid, ctype=ent["content_type"],
-                             meta=ent["meta"])
+                             meta=ent["meta"],
+                             owner=ent.get("owner", ""),
+                             acl=ent.get("acl", ""))
         await self.dst.index.put(bucket, _ver_index_key(key, order),
                                  row)
 
@@ -444,7 +463,9 @@ class RGWSyncAgent:
                        multipart=multipart,
                        vid=cur["version_id"],
                        marker=cur["delete_marker"],
-                       ctype=cur["content_type"], meta=cur["meta"]))
+                       ctype=cur["content_type"], meta=cur["meta"],
+                       owner=cur.get("owner", ""),
+                       acl=cur.get("acl", "")))
 
     async def _raw_current(self, bucket: str, key: str) -> dict | None:
         try:

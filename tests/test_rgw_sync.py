@@ -234,3 +234,40 @@ def test_sigv4_unaffected_requires_datalog():
         await c.stop()
 
     run(t())
+
+
+def test_acl_replication():
+    """ACL changes replicate: on create, on ACL-only rewrite of a
+    plain object, and on an in-place version-row rewrite (round-5
+    review finding: _ent_sig must cover owner/acl, and matching
+    version rows must be re-compared, not just copied-when-missing)."""
+    async def t():
+        c, src, dst, agent = await make()
+        await src.create_bucket("b", owner="alice")
+        await src.put_object("b", "k", b"data", owner="alice",
+                             acl="*:READ")
+        await agent.sync_once()
+        assert await dst.get_bucket_acl("b") == \
+            await src.get_bucket_acl("b")
+        assert await dst.get_object_acl("b", "k") == ("alice", "*:READ")
+        # ACL-only rewrite (same bytes) propagates — e.g. revoking
+        # public-read must not leave the peer zone serving it publicly
+        await src.put_object_acl("b", "k", "alice", "")
+        await agent.sync_once()
+        assert await dst.get_object_acl("b", "k") == ("alice", "")
+        # versioned: in-place ACL rewrite of an EXISTING version row
+        await src.put_bucket_versioning("b", "Enabled")
+        _e, v1 = await src.put_object("b", "vk", b"v1", owner="alice")
+        await agent.sync_once()
+        assert (await dst.get_object_acl("b", "vk",
+                                         version_id=v1)) == \
+            ("alice", "")
+        await src.put_object_acl("b", "vk", "alice", "bob:READ",
+                                 version_id=v1)
+        await agent.sync_once()
+        assert (await dst.get_object_acl("b", "vk",
+                                         version_id=v1)) == \
+            ("alice", "bob:READ")
+        await c.stop()
+
+    run(t())
