@@ -18,6 +18,17 @@ from ..utils import trace
 from . import messages as M
 
 
+#: op verbs gated by the pool FULL flag (mirror of pg.WRITE_OPS, kept
+#: local to avoid importing the PG module into every client). "call"
+#: is included like the PG's own write-class test: object-class
+#: methods may mutate, so they must not bypass quota enforcement.
+_WRITE_VERBS = frozenset((
+    "writefull", "write", "append", "zero", "truncate", "delete",
+    "create", "setxattr", "rmxattr", "omap_setkeys", "omap_rmkeys",
+    "omap_setheader", "omap_clear", "call",
+))
+
+
 class RadosError(IOError):
     """Op-vector failure with its errno-style code attached (librados
     negative-errno contract); str() keeps the legacy message shape."""
@@ -134,7 +145,7 @@ class RadosClient:
             if fut is not None and not fut.done():
                 fut.set_result(msg)
         elif isinstance(msg, (M.MPoolSnapReply, M.MPoolSetReply,
-                              M.MBlocklistReply)):
+                              M.MBlocklistReply, M.MMonCommandReply)):
             fut = self._snap_ops.get(msg.tid)
             if fut is not None and not fut.done():
                 fut.set_result(msg)
@@ -312,6 +323,12 @@ class RadosClient:
                       snapid=None) -> M.MOSDOpReply:
         if self.osdmap is None or pool_id not in self.osdmap.pools:
             await self._wait_pool(pool_id)
+        pool = self.osdmap.pools[pool_id]
+        if pool.full and any(o[0] in _WRITE_VERBS for o in ops):
+            # pool quota reached (FLAG_FULL_QUOTA): fail writes with
+            # EDQUOT like the reference's objecter_full_try stance
+            raise RadosError(M.EDQUOT,
+                             f"pool '{pool.name}' quota reached")
         oid = name.encode() if isinstance(name, str) else bytes(name)
         pgid = self.osdmap.object_to_pg(pool_id, oid)
         reply = await self._submit_pg(pgid, oid, ops, snapc=snapc,
@@ -356,6 +373,38 @@ class RadosClient:
         return sorted(names)
 
     # ------------------------------------------------------------ surface
+
+    async def mon_command(self, cmd: dict | list,
+                          ) -> tuple[int, str, bytes]:
+        """Send one MonCommand (`ceph` CLI seam): cmd is the JSON
+        object {"prefix": ..., args} or an argv list matched against
+        the mon's descriptor table. Returns (rc, outs, outb)."""
+        import json as _json
+
+        if isinstance(cmd, list):
+            from . import moncommands
+
+            matched = moncommands.match_argv([str(w) for w in cmd])
+            if matched is None:
+                return (-22, f"no command matches {cmd!r}", b"")
+            cmd = matched
+        last_exc: Exception | None = None
+        for _attempt in range(3):
+            self._tid += 1
+            tid = self._tid
+            fut = asyncio.get_running_loop().create_future()
+            self._snap_ops[tid] = fut
+            try:
+                await self._mon_send(
+                    M.MMonCommand(tid=tid, cmd=_json.dumps(cmd)))
+                reply = await asyncio.wait_for(fut, self.op_timeout)
+                await self._await_epoch(reply.epoch)
+                return reply.result, reply.outs, reply.outb
+            except (asyncio.TimeoutError, IOError) as e:
+                last_exc = e
+            finally:
+                self._snap_ops.pop(tid, None)
+        raise IOError(f"mon command failed: {last_exc}")
 
     async def create_pool(self, pool: Pool) -> int:
         # retried whole: the mon's pool-create is idempotent by (id,

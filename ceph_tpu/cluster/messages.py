@@ -54,6 +54,7 @@ EAGAIN = -11
 EEXIST = -17
 EBLOCKLISTED = -108  # ESHUTDOWN, the reference's blocklist errno
 ESTALE = -116
+EDQUOT = -122  # pool quota reached (FLAG_FULL_QUOTA)
 
 
 # ------------------------------------------------------------------- mon
@@ -568,9 +569,38 @@ class MPaxosCommit(Message):
 class MMgrReport(Message):
     TYPE = 55
     # perf: JSON-encoded perf-dump (control plane; schema-free like the
-    # reference's MMgrReport counter payloads), pgs: state -> count
+    # reference's MMgrReport counter payloads), pgs: state -> count,
+    # pools: JSON {pool_id: [stored_bytes, primary_objects]} sampled
+    # from the OSD's local collections (pg stat_sum role)
     FIELDS = (("osd", "u32"), ("epoch", "u32"), ("perf", "bytes"),
-              ("pgs", "map:str:u32"))
+              ("pgs", "map:str:u32"), ("pools", "bytes"))
+    DEFAULTS = {"pools": b"{}"}
+
+
+@register_message
+class MMgrDigest(Message):
+    """Mgr -> mon stats digest (the MMonMgrReport/MgrStatMonitor role):
+    the mon serves `status` / `df` / `pg stat` MonCommands from the
+    last digest instead of holding per-OSD reports itself."""
+    TYPE = 92
+    FIELDS = (("digest", "bytes"),)  # JSON: pg_states, pools, ops
+
+
+@register_message
+class MMonCommand(Message):
+    """CLI -> mon command (MMonCommand + MonCommands.h role): cmd is
+    the JSON argument object, {"prefix": "osd tree", ...args}."""
+    TYPE = 93
+    FIELDS = (("tid", "u64"), ("cmd", "str"))
+
+
+@register_message
+class MMonCommandReply(Message):
+    """Reply: result (negated errno), outs (human status line), outb
+    (JSON payload for structured output)."""
+    TYPE = 94
+    FIELDS = (("tid", "u64"), ("result", "i32"), ("outs", "str"),
+              ("outb", "bytes"), ("epoch", "u32"))
 
 
 # ------------------------------------------------------------------ scrub

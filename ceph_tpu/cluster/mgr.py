@@ -68,7 +68,38 @@ class MgrLite(ModuleHost):
                                     M.MMonSubscribe(what="osdmap"))
             except Exception:
                 pass  # no mon yet / mid-election: retry next tick
+            try:
+                await self.bus.send(
+                    self.name, "mon",
+                    M.MMgrDigest(digest=json.dumps(
+                        self._digest()).encode()))
+            except Exception:
+                pass
             await asyncio.sleep(1.0)
+
+    def _digest(self) -> dict:
+        """Stats digest for the mon (MMonMgrReport role): aggregated
+        pg states and per-pool [stored_bytes, objects] — the source
+        for `ceph status` / `df` / `pg stat` and quota checks.
+
+        Only UP OSDs contribute: a dead OSD's last report would keep
+        counting bytes that recovery re-replicates onto survivors,
+        double-counting usage (and falsely tripping quotas)."""
+        pg_states: dict[str, int] = {}
+        pools: dict[str, list[int]] = {}
+        ops = 0
+        osdmap = self.mon.osdmap
+        for o, rep in self.reports.items():
+            if not (0 <= o < osdmap.n_osds and osdmap.osds[o].up):
+                continue
+            for state, n in rep["pgs"].items():
+                pg_states[state] = pg_states.get(state, 0) + n
+            for pid, (b, ob) in rep.get("pools", {}).items():
+                ent = pools.setdefault(pid, [0, 0])
+                ent[0] += b
+                ent[1] += ob
+            ops += int(rep["perf"].get("op", 0))
+        return {"pg_states": pg_states, "pools": pools, "ops": ops}
 
     async def stop(self) -> None:
         await self._stop_all_modules()
@@ -171,6 +202,7 @@ class MgrLite(ModuleHost):
                 "epoch": msg.epoch,
                 "perf": json.loads(msg.perf.decode() or "{}"),
                 "pgs": dict(msg.pgs),
+                "pools": json.loads(msg.pools.decode() or "{}"),
             }
             self.notify_all("reports", msg.osd)
             epoch = self.mon.osdmap.epoch

@@ -56,6 +56,11 @@ class MonLite:
         self.history: dict[int, bytes] = {}  # epoch -> encoded incremental
         #: central config DB (ConfigMonitor role): (who, key) -> value
         self.config_db: dict[tuple[str, str], str] = {}
+        #: last stats digest from the mgr (MgrStatMonitor role) — feeds
+        #: `status`/`df`/`pg stat` MonCommands and pool-quota checks
+        self.mgr_digest: dict = {}
+        #: pool id -> human reason, set while a quota is exceeded
+        self.full_pools: dict[int, str] = {}
         self._watchdog: asyncio.Task | None = None
         self._next_pool_id = 1
         #: serializes read-modify-commit pool mutations (snap id
@@ -168,6 +173,89 @@ class MonLite:
             await self._handle_config_set(msg)
         elif isinstance(msg, M.MUpmapItems):
             await self._handle_upmap_items(msg)
+        elif isinstance(msg, M.MMgrDigest):
+            await self._handle_mgr_digest(msg)
+        elif isinstance(msg, M.MMonCommand):
+            await self._handle_command(src, msg)
+
+    async def _handle_command(self, src: str, msg: M.MMonCommand) -> None:
+        """`ceph` CLI entry (MMonCommand + MonCommands.h dispatch)."""
+        import json
+
+        from . import moncommands
+
+        try:
+            cmd = json.loads(msg.cmd)
+            if not isinstance(cmd, dict):
+                raise ValueError
+        except ValueError:
+            rc, outs, outb = -22, "command must be a JSON object", b""
+        else:
+            rc, outs, outb = await moncommands.dispatch(self, cmd)
+        await self.bus.send(
+            self.name, src,
+            M.MMonCommandReply(tid=msg.tid, result=rc, outs=outs,
+                               outb=outb, epoch=self.osdmap.epoch))
+
+    async def _handle_mgr_digest(self, msg: M.MMgrDigest) -> None:
+        import json
+
+        try:
+            self.mgr_digest = json.loads(msg.digest.decode() or "{}")
+        except ValueError:
+            return
+        await self._check_quotas()
+
+    async def _check_quotas(self) -> None:
+        """Set/clear the pool FULL flag from digest usage vs quotas
+        (OSDMonitor FLAG_FULL_QUOTA role). Digest bytes are RAW
+        (summed over replicas/shards); quotas bound LOGICAL bytes, so
+        raw is scaled down by the pool's redundancy factor."""
+        usage = self.mgr_digest.get("pools", {})
+        for pid, pool in list(self.osdmap.pools.items()):
+            if not pool.quota_max_bytes and not pool.quota_max_objects:
+                if pool.full:
+                    await self._set_pool_full(pid, False, "")
+                continue
+            if str(pid) not in usage:
+                # no stats for this pool yet (mgr/cluster restart):
+                # "unknown" must not clear a persisted FULL flag —
+                # that would re-open writes on an over-quota pool
+                continue
+            raw, objs = usage.get(str(pid), (0, 0))
+            if pool.type == "erasure":
+                k = int(pool.ec_profile.get("k", 2))
+                factor = (k + int(pool.ec_profile.get("m", 1))) / k
+            else:
+                factor = pool.size
+            stored = int(raw / max(1.0, factor))
+            over = []
+            if pool.quota_max_bytes and stored >= pool.quota_max_bytes:
+                over.append(f"bytes {stored} >= {pool.quota_max_bytes}")
+            if pool.quota_max_objects and objs >= pool.quota_max_objects:
+                over.append(f"objects {objs} >= {pool.quota_max_objects}")
+            if bool(over) != pool.full:
+                await self._set_pool_full(
+                    pid, bool(over),
+                    f"pool '{pool.name}': " + "; ".join(over))
+
+    async def _set_pool_full(self, pool_id: int, full: bool,
+                             reason: str) -> None:
+        import copy
+
+        async with self._pool_mut_lock:
+            pool = self.osdmap.pools.get(pool_id)
+            if pool is None or pool.full == full:
+                return
+            pool = copy.deepcopy(pool)
+            pool.full = full
+            inc = self._new_inc()
+            inc.new_pools.append(pool)
+            await self.commit(inc)
+        if full:
+            self.full_pools[pool_id] = reason
+        else:
+            self.full_pools.pop(pool_id, None)
 
     async def _handle_boot(self, src: str, msg: M.MOSDBoot) -> None:
         osd = msg.osd
@@ -203,6 +291,16 @@ class MonLite:
 
     async def _handle_pool_create(self, src: str, msg: M.MPoolCreate) -> None:
         pool, _ = menc._dec_pool(msg.pool, 0)
+        rc, pool_id = await self.pool_create(pool)
+        await self.bus.send(
+            self.name, src,
+            M.MPoolCreateReply(pool_id=pool_id, epoch=self.osdmap.epoch,
+                               tid=msg.tid, result=rc),
+        )
+
+    async def pool_create(self, pool) -> tuple[int, int]:
+        """Create (or idempotently re-ack) a pool; returns (rc, id).
+        Shared by the message path and MonCommands."""
         async with self._pool_mut_lock:
             existing = next(
                 (p for p in self.osdmap.pools.values()
@@ -218,25 +316,14 @@ class MonLite:
                     getattr(existing, f) == getattr(pool, f)
                     for f in ("size", "min_size", "crush_rule", "type",
                               "ec_profile"))
-                await self.bus.send(
-                    self.name, src,
-                    M.MPoolCreateReply(pool_id=existing.id,
-                                       epoch=self.osdmap.epoch,
-                                       tid=msg.tid,
-                                       result=M.OK if same else M.EEXIST),
-                )
-                return
+                return (M.OK if same else M.EEXIST), existing.id
             if pool.id < 0:
                 pool.id = self._next_pool_id
             self._next_pool_id = max(self._next_pool_id, pool.id + 1)
             inc = self._new_inc()
             inc.new_pools.append(pool)
             await self.commit(inc)
-        await self.bus.send(
-            self.name, src,
-            M.MPoolCreateReply(pool_id=pool.id, epoch=self.osdmap.epoch,
-                               tid=msg.tid),
-        )
+        return M.OK, pool.id
 
     async def _handle_pool_snap(self, src: str, msg: M.MPoolSnapOp) -> None:
         """Selfmanaged snap allocation / removal (OSDMonitor snap verbs):
@@ -295,31 +382,35 @@ class MonLite:
         enforces pow2-aligned splits the same way). pgp_num trails
         pg_num: bumping it re-places children via normal peering.
         """
+        rc = await self.pool_set(msg.pool_id, msg.key, msg.value)
+        await self.bus.send(
+            self.name, src,
+            M.MPoolSetReply(pool_id=msg.pool_id, result=rc,
+                            epoch=self.osdmap.epoch, tid=msg.tid),
+        )
+
+    async def pool_set(self, pool_id: int, key: str, value: str) -> int:
+        """Apply one pool-parameter change; returns rc. Shared by the
+        message path and MonCommands."""
         import copy
 
-        async def reply(result: int) -> None:
-            await self.bus.send(
-                self.name, src,
-                M.MPoolSetReply(pool_id=msg.pool_id, result=result,
-                                epoch=self.osdmap.epoch, tid=msg.tid),
-            )
-
-        pool0 = self.osdmap.pools.get(msg.pool_id)
+        pool0 = self.osdmap.pools.get(pool_id)
         if pool0 is None:
-            await reply(M.ENOENT)
-            return
-        val = int(msg.value)
+            return M.ENOENT
+        try:
+            val = int(value)
+        except ValueError:
+            return -22
 
         def _pow2(n: int) -> bool:
             return n > 0 and (n & (n - 1)) == 0
 
         async with self._pool_mut_lock:
-            pool = copy.deepcopy(self.osdmap.pools[msg.pool_id])
-            if msg.key == "pg_num":
+            pool = copy.deepcopy(self.osdmap.pools[pool_id])
+            if key == "pg_num":
                 if (not _pow2(val) or not _pow2(pool.pg_num)
                         or val > MAX_POOL_PG_NUM):
-                    await reply(-22)
-                    return
+                    return -22
                 if val < pool.pg_num:
                     # merge preconditions (the pg_num_pending role):
                     # children must already be CO-LOCATED with their
@@ -329,21 +420,22 @@ class MonLite:
                     # collections in lockstep
                     if val < pool.pgp_num or any(
                             pg[0] == pool.id for pg in self.osdmap.pg_temp):
-                        await reply(-11)  # EAGAIN: not clean yet, retry
-                        return
+                        return -11  # EAGAIN: not clean yet, retry
                 pool.pg_num = val
-            elif msg.key == "pgp_num":
+            elif key == "pgp_num":
                 if (val > pool.pg_num or val < 1
                         or (val < pool.pgp_num and not _pow2(val))):
-                    await reply(-22)
-                    return
+                    return -22
                 pool.pgp_num = val
+            elif key in ("quota_max_bytes", "quota_max_objects"):
+                if val < 0:
+                    return -22
+                setattr(pool, key, val)
             else:
-                await reply(-22)
-                return
+                return -22
             inc = self._new_inc()
             inc.new_pools.append(pool)
-            if msg.key == "pgp_num":
+            if key == "pgp_num":
                 # pin every re-placed PG to its CURRENT acting set with
                 # pg_temp (the choose_acting/pg_temp arc): the old
                 # members keep serving IO and migrate data to the new
@@ -355,8 +447,8 @@ class MonLite:
                     acting, _ = self.osdmap.pg_to_up_acting_osds(
                         (pool.id, ps))
                     old_acting[ps] = acting
-                saved = self.osdmap.pools[msg.pool_id]
-                self.osdmap.pools[msg.pool_id] = pool  # probe new map
+                saved = self.osdmap.pools[pool_id]
+                self.osdmap.pools[pool_id] = pool  # probe new map
                 try:
                     for ps in range(pool.pg_num):
                         pgid = (pool.id, ps)
@@ -365,9 +457,9 @@ class MonLite:
                         if up != old_acting[ps]:
                             inc.new_pg_temp[pgid] = old_acting[ps]
                 finally:
-                    self.osdmap.pools[msg.pool_id] = saved
+                    self.osdmap.pools[pool_id] = saved
             await self.commit(inc)
-        await reply(M.OK)
+        return M.OK
 
     async def _handle_blocklist(self, src: str, msg: M.MBlocklist) -> None:
         """Fence/unfence a client entity via a committed map epoch (the

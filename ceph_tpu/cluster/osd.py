@@ -17,6 +17,7 @@ from __future__ import annotations
 import asyncio
 import os
 import sys
+import time
 import traceback
 
 import numpy as np
@@ -210,6 +211,8 @@ class OSDLite:
         self._worker_task: asyncio.Task | None = None
         self._tasks: set[asyncio.Task] = set()
         self.stopped = False
+        self._pool_stats_ts = 0.0
+        self._pool_stats_cache: dict[str, list[int]] = {}
 
     def _declare_counters(self) -> None:
         """The l_osd_* counter set (src/osd/osd_perf_counters.cc role,
@@ -523,11 +526,41 @@ class OSDLite:
                         osd=self.id, epoch=self.epoch,
                         perf=json.dumps(self.perf.dump()).encode(),
                         pgs=pgs,
+                        pools=json.dumps(self._pool_stats()).encode(),
                     ),
                 )
             except Exception:
                 pass  # no mgr registered: reports are best-effort
             await asyncio.sleep(self.hb_interval)
+
+    def _pool_stats(self) -> dict[str, list[int]]:
+        """Per-pool [local stored bytes, primary head-object count]
+        (the pg stat_sum role, sampled from the store). Throttled: a
+        full collection scan per heartbeat would tax the data path."""
+        now = time.monotonic()
+        if now - self._pool_stats_ts < 2.0:
+            return self._pool_stats_cache
+        from . import snaps as sn
+        from .pg import META_OID
+
+        stats: dict[str, list[int]] = {}
+        for pg in self.pgs.values():
+            try:
+                oids = self.store.list_objects(pg.cid)
+            except Exception:
+                continue
+            ent = stats.setdefault(str(pg.pgid[0]), [0, 0])
+            for oid in oids:
+                try:
+                    ent[0] += self.store.stat(pg.cid, oid)
+                except Exception:
+                    continue
+                if (pg.is_primary() and oid != META_OID
+                        and not sn.is_clone_oid(oid)):
+                    ent[1] += 1
+        self._pool_stats_cache = stats
+        self._pool_stats_ts = now
+        return stats
 
     # ------------------------------------------------------------ dispatch
 
@@ -933,8 +966,36 @@ class OSDLite:
             elif pool.pg_num < prev:
                 self._merge_pool_children(pool, prev)
             self._pool_pg_num[pool.id] = pool.pg_num
+        self._drop_deleted_pools()
         self._scan_pgs()
         self._kick_snap_trim()
+
+    def _drop_deleted_pools(self) -> None:
+        """Tear down PGs whose pool left the map (`osd pool rm` role):
+        stop the PG, delete its objects, drop the collection."""
+        from ..store import transaction as tx
+
+        for key in [k for k in self.pgs
+                    if k[0] not in self.osdmap.pools]:
+            pg = self.pgs.pop(key)
+            if pg._peer_task and not pg._peer_task.done():
+                pg._peer_task.cancel()
+            try:
+                oids = self.store.list_objects(pg.cid)
+            except Exception:
+                continue  # collection never materialized: nothing to do
+            t = tx.Transaction()
+            for oid in oids:
+                t.remove(pg.cid, oid)
+            t.remove_collection(pg.cid)
+            try:
+                self.store.queue_transaction(t)
+            except Exception:
+                self.log_exc(f"pg {pg.pgid} pool-delete cleanup")
+        for pid in [p for p in self._pool_pg_num
+                    if p not in self.osdmap.pools]:
+            self._pool_pg_num.pop(pid, None)
+            self._trimmed_snaps.pop(pid, None)
 
     def _kick_snap_trim(self) -> None:
         """Launch trimming for snap ids newly marked removed in the map

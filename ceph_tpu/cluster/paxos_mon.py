@@ -374,7 +374,8 @@ class PaxosMon(MonLite):
                 self.store.replace_config(self.config_db)
         elif isinstance(msg, (M.MOSDBoot, M.MFailure, M.MPoolCreate,
                               M.MPoolSnapOp, M.MPoolSet, M.MPGTempClear,
-                              M.MConfigSet, M.MUpmapItems, M.MBlocklist)):
+                              M.MConfigSet, M.MUpmapItems, M.MBlocklist,
+                              M.MMonCommand, M.MMgrDigest)):
             # map-mutating requests: a peon forwards to the leader
             # (Monitor::forward_request_leader role); commits that race
             # a leadership change fail quietly and the requester retries

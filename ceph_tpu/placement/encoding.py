@@ -12,7 +12,7 @@ from ..utils import denc
 from . import crushmap as cm
 from .osdmap import Incremental, OSDMap, OSDState, Pool
 
-_V = 3  # v3: +osdmap blocklist
+_V = 4  # v4: +pool quotas/full flag, +removed_pools
 
 
 # ----------------------------------------------------------------- crush
@@ -151,6 +151,9 @@ def _enc_pool(p: Pool) -> bytes:
                 p.removed_snaps,
                 lambda iv: denc.enc_u64(iv[0]) + denc.enc_u64(iv[1]),
             ),
+            denc.enc_u64(p.quota_max_bytes),
+            denc.enc_u64(p.quota_max_objects),
+            denc.enc_u8(1 if p.full else 0),
         )
     )
 
@@ -173,10 +176,14 @@ def _dec_pool(buf, off):
         return (lo, hi), o
 
     removed, off = denc.dec_list(buf, off, _iv)
+    qb, off = denc.dec_u64(buf, off)
+    qo, off = denc.dec_u64(buf, off)
+    fl, off = denc.dec_u8(buf, off)
     return (
         Pool(id=pid, name=name, size=size, min_size=min_size, pg_num=pg_num,
              crush_rule=rule, type=ptype, pgp_num=pgp, ec_profile=prof,
-             snap_seq=snap_seq, removed_snaps=removed),
+             snap_seq=snap_seq, removed_snaps=removed,
+             quota_max_bytes=qb, quota_max_objects=qo, full=bool(fl)),
         off,
     )
 
@@ -312,6 +319,7 @@ def encode_incremental(inc: Incremental) -> bytes:
                          denc.enc_u32),
             denc.enc_list(inc.new_blocklist, denc.enc_str),
             denc.enc_list(inc.new_unblocklist, denc.enc_str),
+            denc.enc_list(inc.removed_pools, denc.enc_i32),
         )
     )
 
@@ -348,6 +356,7 @@ def decode_incremental(buf: bytes, off: int = 0) -> tuple[Incremental, int]:
     paff, off = denc.dec_map(buf, off, denc.dec_u32, denc.dec_u32)
     bl, off = denc.dec_list(buf, off, denc.dec_str)
     unbl, off = denc.dec_list(buf, off, denc.dec_str)
+    rmp, off = denc.dec_list(buf, off, denc.dec_i32)
     return (
         Incremental(
             epoch=epoch, up=up, down=down, weights=weights, new_pools=pools,
@@ -358,6 +367,7 @@ def decode_incremental(buf: bytes, off: int = 0) -> tuple[Incremental, int]:
             new_pg_temp=pg_temp, new_primary_temp=ptemp,
             new_primary_affinity=paff,
             new_blocklist=bl, new_unblocklist=unbl,
+            removed_pools=rmp,
         ),
         off,
     )

@@ -97,6 +97,12 @@ class Pool:
     #: interval set that drives OSD-side snap trimming
     snap_seq: int = 0
     removed_snaps: list[tuple[int, int]] = field(default_factory=list)
+    #: pool quotas (pg_pool_t quota_max_bytes/objects): 0 = unlimited;
+    #: `full` is the FLAG_FULL_QUOTA role — committed by the mon when
+    #: the mgr digest crosses a quota, checked by clients before writes
+    quota_max_bytes: int = 0
+    quota_max_objects: int = 0
+    full: bool = False
 
     def __post_init__(self):
         if self.pgp_num == 0:
@@ -408,6 +414,19 @@ class OSDMap:
                 self.primary_affinity[osd] = aff
         self.blocklist.update(inc.new_blocklist)
         self.blocklist.difference_update(inc.new_unblocklist)
+        for pid in inc.removed_pools:
+            self.pools.pop(pid, None)
+            self.pg_temp = {k: v for k, v in self.pg_temp.items()
+                            if k[0] != pid}
+            self.primary_temp = {
+                k: v for k, v in self.primary_temp.items() if k[0] != pid}
+            self.pg_upmap = {k: v for k, v in self.pg_upmap.items()
+                             if k[0] != pid}
+            self.pg_upmap_items = {
+                k: v for k, v in self.pg_upmap_items.items() if k[0] != pid}
+            self.pg_upmap_primaries = {
+                k: v for k, v in self.pg_upmap_primaries.items()
+                if k[0] != pid}
         self._out_weights_cache = None
         self.epoch = inc.epoch
 
@@ -478,3 +497,6 @@ class Incremental:
     # fenced / unfenced client entity names (osd blocklist role)
     new_blocklist: list[str] = field(default_factory=list)
     new_unblocklist: list[str] = field(default_factory=list)
+    # deleted pool ids (`ceph osd pool rm` role): OSDs drop the pool's
+    # PGs and collections when this epoch applies
+    removed_pools: list[int] = field(default_factory=list)

@@ -1,0 +1,273 @@
+"""MonCommand surface + `ceph` CLI (src/ceph.in + MonCommands.h roles):
+argv matching against the served descriptor table, map/status/pool
+commands, pool quotas (FLAG_FULL_QUOTA), and pool deletion."""
+import asyncio
+import json
+
+import pytest
+
+from ceph_tpu.cluster import moncommands
+from ceph_tpu.cluster import messages as M
+from ceph_tpu.cluster.client import RadosError
+from ceph_tpu.cluster.vstart import TestCluster
+from ceph_tpu.placement.osdmap import Pool
+
+
+def run(coro):
+    asyncio.run(asyncio.wait_for(coro, 120))
+
+
+async def make(n_osds=4):
+    c = TestCluster(n_osds=n_osds)
+    await c.start()
+    await c.client.create_pool(
+        Pool(id=1, name="p", size=3, pg_num=8, crush_rule=0))
+    await c.wait_active(20)
+    return c
+
+
+# ------------------------------------------------------- argv matching
+
+
+def test_match_argv():
+    assert moncommands.match_argv(["status"]) == {"prefix": "status"}
+    assert moncommands.match_argv(["osd", "tree"]) == {
+        "prefix": "osd tree"}
+    # literal-prefix beats shorter commands; params coerce types
+    got = moncommands.match_argv(["osd", "pool", "set", "p",
+                                  "pg_num", "16"])
+    assert got == {"prefix": "osd pool set", "pool": "p",
+                   "var": "pg_num", "val": "16"}
+    got = moncommands.match_argv(["osd", "out", "1", "3"])
+    assert got == {"prefix": "osd out", "ids": [1, 3]}
+    got = moncommands.match_argv(["osd", "reweight", "2", "0.5"])
+    assert got["id"] == 2 and got["weight"] == 0.5
+    # optional arg omitted / present
+    assert moncommands.match_argv(["health"]) == {"prefix": "health"}
+    assert moncommands.match_argv(["health", "detail"])["detail"] \
+        == "detail"
+    # junk does not match
+    assert moncommands.match_argv(["osd", "frobnicate"]) is None
+    assert moncommands.match_argv(["osd", "reweight", "2", "x"]) is None
+
+
+def test_descriptions_served():
+    async def t():
+        c = await make(n_osds=3)
+        try:
+            rc, _outs, outb = await c.client.mon_command(
+                ["get_command_descriptions"])
+            assert rc == 0
+            descs = json.loads(outb)
+            prefixes = {d["prefix"] for d in descs}
+            assert {"status", "osd tree", "osd pool create",
+                    "config dump"} <= prefixes
+        finally:
+            await c.stop()
+
+    run(t())
+
+
+# -------------------------------------------------------- map commands
+
+
+def test_status_health_tree_and_df():
+    async def t():
+        c = await make()
+        try:
+            for i in range(6):
+                await c.client.write_full(1, f"o{i}", b"z" * 500)
+            # poll until OSD reports -> mgr digest -> mon land (the
+            # stats path is throttled at ~2 s + 1 s digest tick)
+            for _ in range(60):
+                rc, outs, outb = await c.client.mon_command(["status"])
+                assert rc == 0
+                st = json.loads(outb)
+                if st["pgmap"]["objects"] == 6:
+                    break
+                await asyncio.sleep(0.25)
+            assert st["osdmap"]["num_up_osds"] == 4
+            assert st["pgmap"]["num_pools"] == 1
+            assert st["pgmap"]["pgs_by_state"].get("active", 0) > 0
+            assert st["pgmap"]["objects"] == 6
+            assert "HEALTH_OK" in outs
+
+            rc, outs, _ = await c.client.mon_command(["health"])
+            assert rc == 0 and outs.startswith("HEALTH_OK")
+
+            rc, outs, outb = await c.client.mon_command(["osd", "tree"])
+            assert rc == 0
+            nodes = json.loads(outb)
+            osd_rows = [n for n in nodes if n["type"] == "osd"]
+            assert len(osd_rows) == 4
+            assert all(n["status"] == "up" for n in osd_rows)
+
+            rc, _, outb = await c.client.mon_command(["df"])
+            pools = json.loads(outb)["pools"]
+            assert pools[0]["name"] == "p"
+            assert pools[0]["objects"] == 6
+            # size-3 replication: raw stored bytes ~ 3 * 6 * 500
+            assert pools[0]["stored_bytes"] >= 3 * 6 * 500
+
+            rc, outs, outb = await c.client.mon_command(["pg", "stat"])
+            assert rc == 0 and json.loads(outb)["num_pgs"] > 0
+
+            rc, _, outb = await c.client.mon_command(["osd", "ls"])
+            assert json.loads(outb) == [0, 1, 2, 3]
+        finally:
+            await c.stop()
+
+    run(t())
+
+
+def test_osd_out_in_and_reweight():
+    async def t():
+        c = await make()
+        try:
+            rc, outs, _ = await c.client.mon_command(["osd", "out", "3"])
+            assert rc == 0
+            assert c.mon.osdmap.osds[3].weight == 0
+            rc, _, _ = await c.client.mon_command(["osd", "in", "3"])
+            assert c.mon.osdmap.osds[3].weight == 0x10000
+            rc, _, _ = await c.client.mon_command(
+                ["osd", "reweight", "3", "0.25"])
+            assert c.mon.osdmap.osds[3].weight == 0x4000
+            rc, outs, _ = await c.client.mon_command(
+                ["osd", "reweight", "9", "0.5"])
+            assert rc == M.ENOENT
+        finally:
+            await c.stop()
+
+    run(t())
+
+
+def test_pool_create_get_set_and_config():
+    async def t():
+        c = await make(n_osds=3)
+        try:
+            rc, outs, outb = await c.client.mon_command(
+                ["osd", "pool", "create", "rep2", "8", "replicated",
+                 "2"])
+            assert rc == 0
+            pid = json.loads(outb)["pool_id"]
+            assert c.mon.osdmap.pools[pid].size == 2
+
+            rc, _, outb = await c.client.mon_command(
+                ["osd", "pool", "ls"])
+            assert set(json.loads(outb)) == {"p", "rep2"}
+
+            rc, _, outb = await c.client.mon_command(
+                ["osd", "pool", "get", "rep2", "size"])
+            assert json.loads(outb) == {"size": 2}
+
+            rc, _, _ = await c.client.mon_command(
+                ["osd", "pool", "set", "rep2", "pg_num", "16"])
+            assert rc == 0
+            assert c.mon.osdmap.pools[pid].pg_num == 16
+
+            rc, _, _ = await c.client.mon_command(
+                ["config", "set", "osd", "debug_level", "3"])
+            assert rc == 0
+            rc, outs, _ = await c.client.mon_command(
+                ["config", "get", "osd", "debug_level"])
+            assert outs == "3"
+            rc, _, outb = await c.client.mon_command(["config", "dump"])
+            assert any(e["key"] == "debug_level"
+                       for e in json.loads(outb))
+        finally:
+            await c.stop()
+
+    run(t())
+
+
+def test_blocklist_commands():
+    async def t():
+        c = await make(n_osds=3)
+        try:
+            rc, _, _ = await c.client.mon_command(
+                ["osd", "blocklist", "add", "client.evil"])
+            assert rc == 0
+            await c.client._await_epoch(c.mon.osdmap.epoch)
+            rc, _, outb = await c.client.mon_command(
+                ["osd", "blocklist", "ls"])
+            assert json.loads(outb) == ["client.evil"]
+            rc, _, _ = await c.client.mon_command(
+                ["osd", "blocklist", "rm", "client.evil"])
+            assert rc == 0
+            rc, _, outb = await c.client.mon_command(
+                ["osd", "blocklist", "ls"])
+            assert json.loads(outb) == []
+        finally:
+            await c.stop()
+
+    run(t())
+
+
+# ------------------------------------------------------------- quotas
+
+
+def test_pool_quota_blocks_writes_and_clears():
+    async def t():
+        c = await make()
+        try:
+            rc, _, _ = await c.client.mon_command(
+                ["osd", "pool", "set", "p", "quota_max_objects", "3"])
+            assert rc == 0
+            for i in range(4):
+                await c.client.write_full(1, f"q{i}", b"d" * 64)
+            # wait for stats to flow and the mon to flag the pool full
+            for _ in range(80):
+                if c.client.osdmap.pools[1].full:
+                    break
+                await asyncio.sleep(0.25)
+            assert c.client.osdmap.pools[1].full
+            with pytest.raises(RadosError) as ei:
+                await c.client.write_full(1, "overflow", b"x")
+            assert ei.value.code == M.EDQUOT
+            # reads still work on a full pool
+            assert await c.client.read(1, "q0") == b"d" * 64
+            h = moncommands._health(c.mon)
+            assert "POOL_FULL" in h["checks"]
+            # lift the quota: the flag clears and writes resume
+            rc, _, _ = await c.client.mon_command(
+                ["osd", "pool", "set", "p", "quota_max_objects", "0"])
+            for _ in range(80):
+                if not c.client.osdmap.pools[1].full:
+                    break
+                await asyncio.sleep(0.25)
+            assert not c.client.osdmap.pools[1].full
+            await c.client.write_full(1, "overflow", b"x")
+        finally:
+            await c.stop()
+
+    run(t())
+
+
+# ----------------------------------------------------------- pool rm
+
+
+def test_pool_rm_drops_pgs_and_objects():
+    async def t():
+        c = await make()
+        try:
+            for i in range(5):
+                await c.client.write_full(1, f"del{i}", b"y" * 128)
+            rc, _, _ = await c.client.mon_command(
+                ["osd", "pool", "rm", "p"])
+            assert rc == 0
+            assert 1 not in c.mon.osdmap.pools
+            # OSDs drop the pool's PGs + collections on the new epoch
+            for _ in range(40):
+                left = [k for o in c.osds if o is not None
+                        for k in o.pgs if k[0] == 1]
+                if not left:
+                    break
+                await asyncio.sleep(0.1)
+            assert not left
+            rc, _, _ = await c.client.mon_command(
+                ["osd", "pool", "rm", "p"])
+            assert rc == M.ENOENT
+        finally:
+            await c.stop()
+
+    run(t())
