@@ -49,6 +49,10 @@ class NoEnt(FSError, KeyError):
     pass
 
 
+class QuotaExceeded(FSError):
+    """ceph.quota.max_bytes / max_files limit reached (EDQUOT role)."""
+
+
 class Exists(FSError):
     pass
 
@@ -283,3 +287,85 @@ class FSLite:
                                   snapc=self._snapc())
         await self.client.omap_rm(self.pool_id, _dir_oid(parent),
                                   [name.encode()])
+
+    # ------------------------------------------------------------ quotas
+
+    ATTR_QUOTA = "fs.quota"
+
+    async def _dir_ino_of(self, path: str) -> int:
+        parts = self._split(path)
+        if not parts:
+            return ROOT_INO
+        ino = await self._walk(parts)
+        return ino
+
+    async def set_quota(self, path: str, max_bytes: int = 0,
+                        max_files: int = 0) -> None:
+        """Set/clear the dir's quota (ceph.quota.max_bytes/max_files
+        vxattr role; 0 = unlimited, both 0 clears the realm)."""
+        import json
+
+        ino = await self._dir_ino_of(path)
+        await self.client.setxattr(
+            self.pool_id, _dir_oid(ino), self.ATTR_QUOTA,
+            json.dumps({"max_bytes": max_bytes,
+                        "max_files": max_files}).encode())
+
+    async def get_quota_ino(self, ino: int) -> dict | None:
+        import json
+
+        try:
+            raw = await self.client.getxattr(
+                self.pool_id, _dir_oid(ino), self.ATTR_QUOTA)
+        except (KeyError, IOError):
+            return None
+        q = json.loads(raw)
+        return q if q.get("max_bytes") or q.get("max_files") else None
+
+    async def nearest_quota(self, path: str
+                            ) -> tuple[str, dict] | None:
+        """Deepest quota realm at or above ``path`` (the snaprealm-
+        style quota-realm lookup of Client::get_quota_root)."""
+        best = None
+        q = await self.get_quota_ino(ROOT_INO)
+        if q is not None:
+            best = ("/", q)
+        ino, prefix = ROOT_INO, ""
+        for part in self._split(path):
+            try:
+                ent = await self._dentry(ino, part)
+            except NoEnt:
+                break
+            if ent["type"] != T_DIR:
+                break
+            ino = ent["ino"]
+            prefix += "/" + part
+            q = await self.get_quota_ino(ino)
+            if q is not None:
+                best = (prefix, q)
+        return best
+
+    async def subtree_stats(self, path: str) -> tuple[int, int, int]:
+        """(rbytes, rfiles, rsubdirs) — the rstat role, computed by a
+        walk. The reference maintains these incrementally (rstats in
+        CDir fnodes); at this build's scale an on-demand walk keeps
+        the metadata path simpler and is exact at query time (modulo
+        client-buffered sizes not yet flushed through caps)."""
+        rbytes = rfiles = rsubdirs = 0
+        todo = [await self._dir_ino_of(path)]
+        while todo:
+            ino = todo.pop()
+            try:
+                omap = await self.client.omap_get(self.pool_id,
+                                                  _dir_oid(ino))
+            except KeyError:
+                continue
+            for raw in omap.values():
+                ent = _dec_inode(raw)
+                if ent["type"] == T_DIR:
+                    rsubdirs += 1
+                    todo.append(ent["ino"])
+                else:
+                    rfiles += 1
+                    rbytes += ent["size"]
+        return rbytes, rfiles, rsubdirs

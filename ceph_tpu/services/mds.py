@@ -43,6 +43,7 @@ directories between ranks on decaying load counters.
 from __future__ import annotations
 
 import asyncio
+import time
 
 from ..cluster import messages as M
 from ..utils import denc
@@ -467,6 +468,8 @@ class MDSLite:
             reply = M.MClientReply(tid=msg.tid, result=-17, out={})
         except fslib.NotEmpty:
             reply = M.MClientReply(tid=msg.tid, result=-39, out={})
+        except fslib.QuotaExceeded:
+            reply = M.MClientReply(tid=msg.tid, result=-122, out={})
         except fslib.FSError:
             reply = M.MClientReply(tid=msg.tid, result=-22, out={})
         except Exception:
@@ -560,6 +563,26 @@ class MDSLite:
                 [n.encode() for n in names], denc.enc_bytes)}
         if verb in ("snapstat", "snaplist"):
             return await self._serve_snap_read(verb, args, path)
+        if verb == "getquota":
+            # nearest quota realm + its current usage (the client
+            # enforces max_bytes on its own writes with this — the
+            # Client::check_quota_condition role)
+            realm = await self.fs.nearest_quota(path)
+            if realm is None:
+                return {"realm": b""}
+            rpath, q = realm
+            rb, rf, rd = await self.fs.subtree_stats(rpath)
+            return {"realm": rpath.encode(),
+                    "max_bytes": denc.enc_u64(q.get("max_bytes") or 0),
+                    "max_files": denc.enc_u64(q.get("max_files") or 0),
+                    "rbytes": denc.enc_u64(rb),
+                    "rfiles": denc.enc_u64(rf + rd)}
+        if verb == "dirstat":
+            # recursive stats (ceph.dir.rbytes/rfiles/rsubdirs vxattrs)
+            rb, rf, rd = await self.fs.subtree_stats(path)
+            return {"rbytes": denc.enc_u64(rb),
+                    "rfiles": denc.enc_u64(rf),
+                    "rsubdirs": denc.enc_u64(rd)}
         # -------- journaled mutations (single-flight via the lock)
         try:
             async with self._lock:
@@ -748,6 +771,13 @@ class MDSLite:
             await self._export_locked(
                 path, denc.dec_u32(args["rank"], 0)[0], pinned=True)
             return {}
+        if verb == "setquota":
+            # dir must exist (walk raises); both-zero clears the realm
+            await self.fs.set_quota(
+                path,
+                max_bytes=denc.dec_u64(args["max_bytes"], 0)[0],
+                max_files=denc.dec_u64(args["max_files"], 0)[0])
+            return {}
         if verb == "create":
             ent = None
             try:
@@ -756,6 +786,7 @@ class MDSLite:
                 pass
             if ent is not None:
                 raise fslib.Exists(path)
+            await self._quota_check_files(path)
             seq = await self._journal(verb, args)
             ino = await self.fs.create(path)
             await self._expire(seq)
@@ -822,10 +853,29 @@ class MDSLite:
                 # writers flush before (not after) the cut. Recalled
                 # here, not in _apply — replay has no clients to call.
                 await self._revoke_conflicting(ent["ino"], src, "w")
+        if verb == "mkdir":
+            await self._quota_check_files(path)
         seq = await self._journal(verb, args)
         out = await self._apply(verb, args)
         await self._expire(seq)
         return out
+
+    async def _quota_check_files(self, path: str) -> None:
+        """EDQUOT when creating one more entry would pass the nearest
+        realm's max_files (MDS-side file-count enforcement; byte
+        quotas are enforced client-side like the reference, since data
+        writes never pass through the MDS)."""
+        parent = _norm(path).rsplit("/", 1)[0] or "/"
+        realm = await self.fs.nearest_quota(parent)
+        if realm is None:
+            return
+        rpath, q = realm
+        if not q.get("max_files"):
+            return
+        _rb, rf, rd = await self.fs.subtree_stats(rpath)
+        if rf + rd >= q["max_files"]:
+            raise fslib.QuotaExceeded(
+                f"{rpath}: {rf + rd} >= max_files {q['max_files']}")
 
     async def _apply_mksnap(self, dir_ino: int, name: str,
                             sid: int) -> None:
@@ -1093,6 +1143,8 @@ class FSClient:
         #: cached data-pool SnapContext (refreshed from every MDS
         #: reply); direct data writes carry it so snapshots COW
         self._snapc: tuple[int, list[int]] = (0, [])
+        #: realm path -> (expiry, quota dict) — see _quota_check_bytes
+        self._quota_cache: dict[str, tuple[float, dict | None]] = {}
 
     async def connect(self) -> None:
         self.bus.register(self.name, self._handle)
@@ -1168,6 +1220,8 @@ class FSClient:
                 raise fslib.Exists(args.get("path", ""))
             if reply.result == -39:
                 raise fslib.NotEmpty(args.get("path", ""))
+            if reply.result == -122:
+                raise fslib.QuotaExceeded(args.get("path", ""))
             raise fslib.FSError(f"{verb} failed: {reply.result}")
         snapc_raw = reply.out.pop("__snapc", None)
         if snapc_raw is not None:
@@ -1209,6 +1263,65 @@ class FSClient:
 
     async def rmdir(self, path: str) -> None:
         await self._req("rmdir", path=path)
+
+    # ------------------------------------------------------------ quotas
+
+    async def set_quota(self, path: str, max_bytes: int = 0,
+                        max_files: int = 0) -> None:
+        """ceph.quota.max_bytes / max_files vxattr role (0 = off)."""
+        await self._req("setquota", path=path,
+                        max_bytes=denc.enc_u64(max_bytes),
+                        max_files=denc.enc_u64(max_files))
+        self._quota_cache.clear()
+
+    async def get_quota(self, path: str) -> dict | None:
+        """Nearest quota realm covering ``path`` with current usage:
+        {realm, max_bytes, max_files, rbytes, rfiles}; None = no
+        realm."""
+        out = await self._req("getquota", path=path)
+        realm = out["realm"].decode()
+        if not realm:
+            return None
+        return {"realm": realm,
+                "max_bytes": denc.dec_u64(out["max_bytes"], 0)[0],
+                "max_files": denc.dec_u64(out["max_files"], 0)[0],
+                "rbytes": denc.dec_u64(out["rbytes"], 0)[0],
+                "rfiles": denc.dec_u64(out["rfiles"], 0)[0]}
+
+    async def dir_stat(self, path: str) -> dict:
+        """Recursive dir stats (ceph.dir.rbytes/rfiles/rsubdirs)."""
+        out = await self._req("dirstat", path=path)
+        return {k: denc.dec_u64(out[k], 0)[0]
+                for k in ("rbytes", "rfiles", "rsubdirs")}
+
+    async def _quota_check_bytes(self, path: str, grow: int) -> None:
+        """Client-side max_bytes enforcement before a growing write
+        (Client::check_quota_condition role — data never passes
+        through the MDS, so the writer itself must check). The realm
+        lookup is cached briefly PER PARENT DIR (a realm-keyed cache
+        would let a cached outer realm shadow a deeper, tighter one),
+        caches negative results too, and advances the cached usage by
+        our own accepted writes so a burst inside one TTL window
+        cannot blow past the limit unchecked. Cross-client lag stays
+        bounded by the TTL, like the reference's cap-propagated
+        realms."""
+        if grow <= 0:
+            return
+        parent = _norm(path).rsplit("/", 1)[0] or "/"
+        now = time.monotonic()
+        hit = self._quota_cache.get(parent)
+        if hit is not None and now < hit[0]:
+            q = hit[1]
+        else:
+            q = await self.get_quota(path)
+            self._quota_cache[parent] = (now + 2.0, q)
+        if q and q["max_bytes"] \
+                and q["rbytes"] + grow > q["max_bytes"]:
+            raise fslib.QuotaExceeded(
+                f"{q['realm']}: {q['rbytes']} + {grow} > "
+                f"max_bytes {q['max_bytes']}")
+        if q:
+            q["rbytes"] += grow
 
     async def listdir(self, path: str = "/") -> list[str]:
         out = await self._req("listdir", path=path)
@@ -1271,10 +1384,17 @@ class FSClient:
                 ino = await self.open(path, "w")
             except fslib.NoEnt:
                 ino = await self.create(path)
+        prev = self.wcaps.get(ino)
+        if prev is None:
+            try:
+                prev = (await self.stat(path))["size"]
+            except fslib.FSError:
+                prev = 0
+        await self._quota_check_bytes(
+            path, offset + len(data) - prev)
         await self.striper.write(fslib._data_name(ino), data, offset,
                                  snapc=self._snapc)
-        self.wcaps[ino] = max(self.wcaps.get(ino, 0),
-                              offset + len(data))
+        self.wcaps[ino] = max(prev, offset + len(data))
 
     @staticmethod
     def _clamp(ent: dict, what: str, offset: int,

@@ -23,6 +23,7 @@ from __future__ import annotations
 import asyncio
 import calendar
 import hashlib
+import json
 import time
 import urllib.parse
 import xml.etree.ElementTree as ET
@@ -192,7 +193,8 @@ def _enc_entry(size: int, etag: str, mtime: float,
                multipart: bool = False, vid: str = "",
                marker: bool = False, ctype: str = "",
                meta: dict[str, str] | None = None,
-               owner: str = "", acl: str = "") -> bytes:
+               owner: str = "", acl: str = "",
+               tags: dict[str, str] | None = None) -> bytes:
     """Index entry: size/etag/mtime/multipart plus the versioning
     fields (rgw_bucket_dir_entry role): ``vid`` names the version the
     entry points at ("" = unversioned/null version at the plain data
@@ -206,11 +208,13 @@ def _enc_entry(size: int, etag: str, mtime: float,
     out = (denc.enc_u64(size) + denc.enc_str(etag)
            + denc.enc_u64(int(mtime)) + denc.enc_u8(multipart)
            + denc.enc_str(vid) + denc.enc_u8(marker))
-    if ctype or meta or owner or acl:
+    if ctype or meta or owner or acl or tags:
         out += denc.enc_str(ctype) + denc.enc_map(
             meta or {}, denc.enc_str, denc.enc_str)
-    if owner or acl:
+    if owner or acl or tags:
         out += denc.enc_str(owner) + denc.enc_str(acl)
+    if tags:
+        out += denc.enc_map(tags, denc.enc_str, denc.enc_str)
     return out
 
 
@@ -230,10 +234,13 @@ def _dec_entry(b: bytes) -> dict:
     if off < len(b):  # and older still lack the acl tail
         owner, off = denc.dec_str(b, off)
         acl, off = denc.dec_str(b, off)
+    tags: dict[str, str] = {}
+    if off < len(b):  # and older still lack the tag tail
+        tags, off = denc.dec_map(b, off, denc.dec_str, denc.dec_str)
     return {"size": size, "etag": etag, "mtime": mtime,
             "multipart": bool(multipart), "version_id": vid,
             "delete_marker": bool(marker), "content_type": ctype,
-            "meta": meta, "owner": owner, "acl": acl}
+            "meta": meta, "owner": owner, "acl": acl, "tags": tags}
 
 
 DATALOG_OID = b".rgw.datalog"
@@ -538,46 +545,53 @@ class RGWLite:
         round-5 review finding)."""
         ent = (_ent if _ent is not None
                else await self.head_object(bucket, key, version_id))
-        row = _enc_entry(ent["size"], ent["etag"], ent["mtime"],
-                         multipart=ent["multipart"],
-                         vid=ent["version_id"],
-                         marker=ent["delete_marker"],
-                         ctype=ent["content_type"], meta=ent["meta"],
-                         owner=owner, acl=acl)
+
+        def build(vid: str, marker: bool) -> bytes:
+            return _enc_entry(ent["size"], ent["etag"], ent["mtime"],
+                              multipart=ent["multipart"], vid=vid,
+                              marker=marker,
+                              ctype=ent["content_type"],
+                              meta=ent["meta"], owner=owner, acl=acl,
+                              tags=ent.get("tags") or None)
+
+        await self._rewrite_entry_rows(bucket, key, ent, build)
+
+    async def _rewrite_entry_rows(self, bucket: str, key: str,
+                                  ent: dict, build) -> None:
+        """Rewrite the index row(s) a resolved entry lives at (shared
+        by the ACL and tagging writers). ``build(vid, marker)`` must
+        return the new encoded entry with the given ON-DISK vid field:
+        the preserved pre-versioning "null" object's data may still
+        sit at the plain current row, whose stored vid must KEEP "" —
+        writing "null" there would corrupt the current pointer. A
+        named version's row is always updated; the bucket's CURRENT
+        pointer is rewritten only when that version actually is
+        current (naming a historical version must never resurrect its
+        data as current — round-5 review finding)."""
         vid = ent["version_id"]
         try:
             cur = await self.index.get(bucket, key)
         except RGWError:
             cur = None
         if vid == "null":
-            # the preserved pre-versioning object: current when the
-            # plain entry is still the un-versioned one (whose row
-            # must KEEP vid="" — writing "null" there would corrupt
-            # the current pointer), otherwise a preserved
-            # mtime-ordered row
             if cur is not None and not cur["version_id"] \
                     and not cur["delete_marker"]:
-                await self.index.put(
-                    bucket, key,
-                    _enc_entry(ent["size"], ent["etag"],
-                               ent["mtime"],
-                               multipart=ent["multipart"],
-                               ctype=ent["content_type"],
-                               meta=ent["meta"], owner=owner,
-                               acl=acl))
+                await self.index.put(bucket, key, build("", False))
             else:
                 await self.index.put(
                     bucket,
                     _ver_index_key(key, _null_order(ent["mtime"])),
-                    row)
+                    build("null", ent["delete_marker"]))
             return
         if vid:
+            row = build(vid, ent["delete_marker"])
             await self.index.put(bucket, _ver_index_key(key, vid),
                                  row)
             if cur is not None and cur["version_id"] == vid:
                 await self.index.put(bucket, key, row)
             return
-        await self.index.put(bucket, key, row)
+        await self.index.put(bucket, key,
+                             build("", ent["delete_marker"]))
 
     async def get_object_acl(self, bucket: str, key: str,
                              version_id: str = "") -> tuple[str, str]:
@@ -587,6 +601,144 @@ class RGWLite:
         if ent["owner"] or ent["acl"]:
             return ent["owner"], ent["acl"]
         return await self.get_bucket_acl(bucket)
+
+    # ----------------------------------------------------------- tagging
+
+    ATTR_TAGGING = "rgw.tagging"
+    ATTR_CORS = "rgw.cors"
+
+    @staticmethod
+    def _validate_tags(tags: dict[str, str], max_n: int = 10) -> None:
+        """S3 tag-set limits (rgw_tag_s3 role): <=10 object tags
+        (50 for buckets), key <=128, value <=256 chars."""
+        if len(tags) > max_n:
+            raise RGWError("InvalidTag", 400, "too many tags")
+        for k, v in tags.items():
+            if not k or len(k) > 128 or len(v) > 256:
+                raise RGWError("InvalidTag", 400, k)
+
+    async def put_object_tagging(self, bucket: str, key: str,
+                                 tags: dict[str, str],
+                                 version_id: str = "") -> str:
+        """Replace the object's tag set (RGWPutObjTags role); tags
+        ride the index entry like the ACL tail, so reads/listings
+        never touch the data object. Returns the affected version id
+        ("" on unversioned buckets)."""
+        await self._require_bucket(bucket)
+        self._validate_tags(tags)
+        ent = await self.head_object(bucket, key, version_id)
+
+        def build(vid: str, marker: bool) -> bytes:
+            return _enc_entry(ent["size"], ent["etag"], ent["mtime"],
+                              multipart=ent["multipart"], vid=vid,
+                              marker=marker,
+                              ctype=ent["content_type"],
+                              meta=ent["meta"], owner=ent["owner"],
+                              acl=ent["acl"], tags=tags or None)
+
+        await self._rewrite_entry_rows(bucket, key, ent, build)
+        return ent["version_id"]
+
+    async def get_object_tagging(self, bucket: str, key: str,
+                                 version_id: str = ""
+                                 ) -> dict[str, str]:
+        ent = await self.head_object(bucket, key, version_id)
+        return dict(ent.get("tags") or {})
+
+    async def delete_object_tagging(self, bucket: str, key: str,
+                                    version_id: str = "") -> str:
+        return await self.put_object_tagging(bucket, key, {},
+                                             version_id=version_id)
+
+    async def put_bucket_tagging(self, bucket: str,
+                                 tags: dict[str, str]) -> None:
+        """Bucket tag set (<=50 per S3); stored as a bucket attr."""
+        self._validate_tags(tags, max_n=50)
+        await self._require_bucket(bucket)
+        await self._log_bucket(bucket)
+        await self.client.setxattr(
+            self.pool_id, _index_oid(bucket), self.ATTR_TAGGING,
+            json.dumps(tags).encode())
+
+    async def get_bucket_tagging(self, bucket: str) -> dict[str, str]:
+        await self._require_bucket(bucket)
+        raw = await self._bucket_xattr(bucket, self.ATTR_TAGGING)
+        return json.loads(raw) if raw else {}
+
+    async def delete_bucket_tagging(self, bucket: str) -> None:
+        await self.put_bucket_tagging(bucket, {})
+
+    # -------------------------------------------------------------- CORS
+
+    async def put_bucket_cors(self, bucket: str,
+                              rules: list[dict]) -> None:
+        """Store the CORS rule list (rgw_cors.h RGWCORSConfiguration
+        role). Each rule: allowed_origins / allowed_methods /
+        allowed_headers / expose_headers (lists) + max_age_seconds."""
+        if len(rules) > 100:
+            raise RGWError("InvalidRequest", 400, "too many rules")
+        for r in rules:
+            if not r.get("allowed_origins") \
+                    or not r.get("allowed_methods"):
+                raise RGWError(
+                    "MalformedXML", 400,
+                    "rule needs AllowedOrigin and AllowedMethod")
+        await self._require_bucket(bucket)
+        await self._log_bucket(bucket)
+        await self.client.setxattr(
+            self.pool_id, _index_oid(bucket), self.ATTR_CORS,
+            json.dumps(rules).encode())
+
+    async def get_bucket_cors(self, bucket: str) -> list[dict]:
+        await self._require_bucket(bucket)
+        raw = await self._bucket_xattr(bucket, self.ATTR_CORS)
+        return json.loads(raw) if raw else []
+
+    async def delete_bucket_cors(self, bucket: str) -> None:
+        await self.put_bucket_cors(bucket, [])
+
+    @staticmethod
+    def cors_match(rules: list[dict], origin: str, method: str,
+                   req_headers: list[str]) -> dict[str, str] | None:
+        """First rule matching (origin, method, headers) -> response
+        headers (rgw_cors.cc RGWCORSRule::is_origin_present +
+        header filtering role); None = no match (403 preflight)."""
+
+        def origin_ok(pat: str) -> bool:
+            if pat == "*" or pat == origin:
+                return True
+            if "*" in pat:  # single-wildcard glob, e.g. https://*.a.com
+                head, _, tail = pat.partition("*")
+                return (origin.startswith(head) and origin.endswith(tail)
+                        and len(origin) >= len(head) + len(tail))
+            return False
+
+        for r in rules:
+            if not any(origin_ok(p) for p in r["allowed_origins"]):
+                continue
+            if method not in r["allowed_methods"]:
+                continue
+            allowed = [h.lower() for h in r.get("allowed_headers", [])]
+            if req_headers and "*" not in allowed and not all(
+                    h.lower() in allowed for h in req_headers):
+                continue
+            out = {
+                "access-control-allow-origin":
+                    "*" if "*" in r["allowed_origins"] else origin,
+                "access-control-allow-methods":
+                    ", ".join(r["allowed_methods"]),
+            }
+            if req_headers:
+                out["access-control-allow-headers"] = \
+                    ", ".join(req_headers)
+            if r.get("expose_headers"):
+                out["access-control-expose-headers"] = \
+                    ", ".join(r["expose_headers"])
+            if r.get("max_age_seconds"):
+                out["access-control-max-age"] = \
+                    str(r["max_age_seconds"])
+            return out
+        return None
 
     async def list_object_versions(self, bucket: str, prefix: str = "",
                                    max_keys: int = 1000) -> list[dict]:
@@ -628,6 +780,7 @@ class RGWLite:
                          content_type: str = "",
                          meta: dict[str, str] | None = None,
                          owner: str = "", acl: str = "",
+                         tags: dict[str, str] | None = None,
                          _event: str = "s3:ObjectCreated:Put"
                          ) -> str | tuple[str, str]:
         """Returns the etag; on a versioning-enabled bucket returns
@@ -647,7 +800,7 @@ class RGWLite:
                 self.pool_id, _ver_oid(bucket, key, vid), data)
             entry = _enc_entry(len(data), etag, now, vid=vid,
                                ctype=content_type, meta=meta,
-                               owner=owner, acl=acl)
+                               owner=owner, acl=acl, tags=tags)
             # the version row, then the current pointer
             await self.index.put(bucket, _ver_index_key(key, vid),
                                  entry)
@@ -664,7 +817,8 @@ class RGWLite:
         await self.index.put(bucket, key,
                              _enc_entry(len(data), etag, time.time(),
                                         ctype=content_type, meta=meta,
-                                        owner=owner, acl=acl))
+                                        owner=owner, acl=acl,
+                                        tags=tags))
         await self._notify(bucket, key, _event, size=len(data),
                            etag=etag)
         return etag
@@ -694,7 +848,8 @@ class RGWLite:
         row = _enc_entry(cur["size"], cur["etag"], cur["mtime"],
                          multipart=cur["multipart"], vid="null",
                          ctype=cur["content_type"], meta=cur["meta"],
-                         owner=cur["owner"], acl=cur["acl"])
+                         owner=cur["owner"], acl=cur["acl"],
+                         tags=cur.get("tags") or None)
         await self.index.put(
             bucket, _ver_index_key(key, _null_order(cur["mtime"])),
             row)
@@ -866,7 +1021,8 @@ class RGWLite:
                            marker=ent["delete_marker"],
                            ctype=ent["content_type"],
                            meta=ent["meta"], owner=ent["owner"],
-                           acl=ent["acl"]))
+                           acl=ent["acl"],
+                           tags=ent.get("tags") or None))
         else:
             await self.index.delete(bucket, key)
 
@@ -884,6 +1040,7 @@ class RGWLite:
             content_type=src["content_type"],
             meta=src["meta"] if meta is None else meta,
             owner=owner, acl=acl,
+            tags=src.get("tags") or None,  # S3 copies the tag set
             _event="s3:ObjectCreated:Copy")
 
     async def list_objects(self, bucket: str, prefix: str = "",
@@ -1134,6 +1291,7 @@ class HttpFrontend:
     subclass and implement ``_handle``."""
 
     async def start(self, host: str = "127.0.0.1", port: int = 0):
+        self._writers: set[asyncio.StreamWriter] = set()
         self._server = await asyncio.start_server(self._conn, host, port)
         self.port = self._server.sockets[0].getsockname()[1]
         return host, self.port
@@ -1141,6 +1299,15 @@ class HttpFrontend:
     async def stop(self) -> None:
         if self._server:
             self._server.close()
+            # sever live keep-alive connections: wait_closed() blocks
+            # on every open handler, and a client that parked an idle
+            # connection (urllib holding a response object, a browser
+            # pool) would hang shutdown forever otherwise
+            for w in list(getattr(self, "_writers", ())):
+                try:
+                    w.close()
+                except Exception:
+                    pass
             await self._server.wait_closed()
 
     async def _handle(self, method: str, target: str, headers: dict,
@@ -1149,6 +1316,14 @@ class HttpFrontend:
 
     async def _conn(self, reader: asyncio.StreamReader,
                     writer: asyncio.StreamWriter) -> None:
+        self._writers.add(writer)
+        try:
+            await self._serve_conn(reader, writer)
+        finally:
+            self._writers.discard(writer)
+
+    async def _serve_conn(self, reader: asyncio.StreamReader,
+                          writer: asyncio.StreamWriter) -> None:
         try:
             while True:
                 line = await reader.readline()
@@ -1210,6 +1385,22 @@ class S3Frontend(HttpFrontend):
         self.port = 0
         #: test hook: fake "now" for the skew check (None = wall clock)
         self._now = None
+        #: bucket -> (expiry, rules) — CORS configs change rarely, and
+        #: browsers send Origin on EVERY request; without this cache
+        #: each cross-origin GET would pay two extra RADOS reads
+        self._cors_cache: dict[str, tuple[float, list]] = {}
+
+    async def _cors_rules(self, bucket: str) -> list[dict]:
+        hit = self._cors_cache.get(bucket)
+        now = time.monotonic()
+        if hit is not None and now < hit[0]:
+            return hit[1]
+        try:
+            rules = await self.rgw.get_bucket_cors(bucket)
+        except RGWError:
+            rules = []
+        self._cors_cache[bucket] = (now + 5.0, rules)
+        return rules
 
     def _authenticate(self, method: str, target: str, headers: dict,
                       body: bytes) -> tuple[str | None, str | None]:
@@ -1316,6 +1507,16 @@ class S3Frontend(HttpFrontend):
 
     async def _handle(self, method: str, target: str, headers: dict,
                       body: bytes) -> tuple[int, dict, bytes]:
+        if method == "OPTIONS":
+            # CORS preflight: unauthenticated by design (browsers
+            # send no credentials on preflight)
+            try:
+                return await self._preflight(target, headers)
+            except RGWError as e:
+                el = ET.Element("Error")
+                ET.SubElement(el, "Code").text = e.code
+                return e.status, {"content-type": "application/xml"}, \
+                    _xml(el)
         err, principal = (
             self._authenticate(method, target, headers, body)
             if self.users else (None, None))
@@ -1323,8 +1524,27 @@ class S3Frontend(HttpFrontend):
             el = ET.Element("Error")
             ET.SubElement(el, "Code").text = err
             return 403, {"content-type": "application/xml"}, _xml(el)
-        return await self._route(method, target, headers, body,
-                                 principal)
+        status, rh, data = await self._route(method, target, headers,
+                                             body, principal)
+        origin = headers.get("origin")
+        if origin:
+            # simple (non-preflight) cross-origin request: attach the
+            # allow headers when a bucket CORS rule matches
+            path = urllib.parse.unquote(
+                urllib.parse.urlsplit(target).path)
+            parts = [p for p in path.split("/") if p]
+            if parts:
+                allow = RGWLite.cors_match(
+                    await self._cors_rules(parts[0]), origin, method,
+                    [])
+                if allow:
+                    rh = {**rh,
+                          "access-control-allow-origin":
+                              allow["access-control-allow-origin"]}
+                    if "access-control-expose-headers" in allow:
+                        rh["access-control-expose-headers"] = allow[
+                            "access-control-expose-headers"]
+        return status, rh, data
 
     # ------------------------------------------------------ authorization
     #
@@ -1432,6 +1652,19 @@ class S3Frontend(HttpFrontend):
                         "FULL_CONTROL" if method == "PUT" else "READ")
                     return await self._bucket_lifecycle(
                         method, bucket, body)
+                if "tagging" in query:
+                    await self._authz_bucket(
+                        bucket, principal,
+                        "READ" if method == "GET" else "FULL_CONTROL")
+                    return await self._bucket_tagging(
+                        method, bucket, body)
+                if "cors" in query:
+                    await self._authz_bucket(
+                        bucket, principal,
+                        "READ" if method == "GET" else "FULL_CONTROL")
+                    self._cors_cache.pop(bucket, None)
+                    return await self._bucket_cors(
+                        method, bucket, body)
                 if "versions" in query:
                     await self._authz_bucket(bucket, principal,
                                              "READ")
@@ -1459,6 +1692,9 @@ class S3Frontend(HttpFrontend):
                 return await self._object_acl_route(
                     method, bucket, key, vid, headers, body,
                     principal)
+            if "tagging" in query:
+                return await self._object_tagging_route(
+                    method, bucket, key, vid, body, principal)
             if method == "PUT":
                 await self._authz_bucket(bucket, principal, "WRITE")
                 grants = self._canned_grants(headers, principal)
@@ -1471,9 +1707,19 @@ class S3Frontend(HttpFrontend):
                         sb, sk, bucket, key,
                         owner=principal or "", acl=grants)
                 else:
+                    tags = None
+                    th = headers.get("x-amz-tagging")
+                    if th:  # url-encoded tag set on the PUT itself
+                        tags = dict(urllib.parse.parse_qsl(th))
+                        RGWLite._validate_tags(tags)
+                    umeta = {k[len("x-amz-meta-"):]: v
+                             for k, v in headers.items()
+                             if k.startswith("x-amz-meta-")}
                     etag = await self.rgw.put_object(
                         bucket, key, body,
-                        owner=principal or "", acl=grants)
+                        content_type=headers.get("content-type", ""),
+                        meta=umeta or None,
+                        owner=principal or "", acl=grants, tags=tags)
                 rh = {}
                 if isinstance(etag, tuple):
                     etag, new_vid = etag
@@ -1488,6 +1734,12 @@ class S3Frontend(HttpFrontend):
                 rh = {"etag": f'"{meta["etag"]}"'}
                 if meta["version_id"]:
                     rh["x-amz-version-id"] = meta["version_id"]
+                if meta["content_type"]:
+                    rh["content-type"] = meta["content_type"]
+                for mk, mv in (meta["meta"] or {}).items():
+                    rh[f"x-amz-meta-{mk}"] = mv
+                if meta.get("tags"):
+                    rh["x-amz-tagging-count"] = str(len(meta["tags"]))
                 return 200, rh, data
             if method == "HEAD":
                 meta = await self._authz_object(bucket, key, vid,
@@ -1612,6 +1864,134 @@ class S3Frontend(HttpFrontend):
                 ET.SubElement(nce, "NoncurrentDays").text = \
                     str(r["noncurrent_days"])
         return 200, {"content-type": "application/xml"}, _xml(root)
+
+    # --------------------------------------------------- tagging + cors
+
+    @staticmethod
+    def _parse_tagging_xml(body: bytes) -> dict[str, str]:
+        try:
+            root = ET.fromstring(body)
+        except ET.ParseError:
+            raise RGWError("MalformedXML") from None
+        tags: dict[str, str] = {}
+        for tag in root.iter("Tag"):
+            k = tag.findtext("Key") or ""
+            tags[k] = tag.findtext("Value") or ""
+        return tags
+
+    @staticmethod
+    def _render_tagging_xml(tags: dict[str, str]) -> bytes:
+        root = ET.Element("Tagging")
+        ts = ET.SubElement(root, "TagSet")
+        for k, v in sorted(tags.items()):
+            el = ET.SubElement(ts, "Tag")
+            ET.SubElement(el, "Key").text = k
+            ET.SubElement(el, "Value").text = v
+        return _xml(root)
+
+    async def _bucket_tagging(self, method: str, bucket: str,
+                              body: bytes):
+        if method == "PUT":
+            await self.rgw.put_bucket_tagging(
+                bucket, self._parse_tagging_xml(body))
+            return 204, {}, b""
+        if method == "DELETE":
+            await self.rgw.delete_bucket_tagging(bucket)
+            return 204, {}, b""
+        tags = await self.rgw.get_bucket_tagging(bucket)
+        if not tags:
+            raise RGWError("NoSuchTagSet", 404)
+        return 200, {"content-type": "application/xml"}, \
+            self._render_tagging_xml(tags)
+
+    async def _object_tagging_route(self, method: str, bucket: str,
+                                    key: str, vid: str, body: bytes,
+                                    principal: str | None):
+        perm = "READ" if method == "GET" else "WRITE"
+        await self._authz_object(bucket, key, vid, principal, perm)
+        if method == "PUT":
+            avid = await self.rgw.put_object_tagging(
+                bucket, key, self._parse_tagging_xml(body),
+                version_id=vid)
+            rh = {"x-amz-version-id": avid} if avid else {}
+            return 200, rh, b""
+        if method == "DELETE":
+            await self.rgw.delete_object_tagging(bucket, key,
+                                                 version_id=vid)
+            return 204, {}, b""
+        tags = await self.rgw.get_object_tagging(bucket, key,
+                                                 version_id=vid)
+        return 200, {"content-type": "application/xml"}, \
+            self._render_tagging_xml(tags)
+
+    async def _bucket_cors(self, method: str, bucket: str,
+                           body: bytes):
+        if method == "PUT":
+            try:
+                root = ET.fromstring(body)
+            except ET.ParseError:
+                raise RGWError("MalformedXML") from None
+            rules = []
+            for r in root.iter("CORSRule"):
+                rule = {
+                    "allowed_origins": [
+                        e.text or "" for e in r.findall("AllowedOrigin")],
+                    "allowed_methods": [
+                        e.text or "" for e in r.findall("AllowedMethod")],
+                    "allowed_headers": [
+                        e.text or "" for e in r.findall("AllowedHeader")],
+                    "expose_headers": [
+                        e.text or "" for e in r.findall("ExposeHeader")],
+                }
+                age = r.findtext("MaxAgeSeconds")
+                if age:
+                    rule["max_age_seconds"] = int(age)
+                rules.append(rule)
+            await self.rgw.put_bucket_cors(bucket, rules)
+            return 200, {}, b""
+        if method == "DELETE":
+            await self.rgw.delete_bucket_cors(bucket)
+            return 204, {}, b""
+        rules = await self.rgw.get_bucket_cors(bucket)
+        if not rules:
+            raise RGWError("NoSuchCORSConfiguration", 404)
+        root = ET.Element("CORSConfiguration")
+        for r in rules:
+            el = ET.SubElement(root, "CORSRule")
+            for o in r["allowed_origins"]:
+                ET.SubElement(el, "AllowedOrigin").text = o
+            for m in r["allowed_methods"]:
+                ET.SubElement(el, "AllowedMethod").text = m
+            for h in r.get("allowed_headers", []):
+                ET.SubElement(el, "AllowedHeader").text = h
+            for h in r.get("expose_headers", []):
+                ET.SubElement(el, "ExposeHeader").text = h
+            if r.get("max_age_seconds"):
+                ET.SubElement(el, "MaxAgeSeconds").text = \
+                    str(r["max_age_seconds"])
+        return 200, {"content-type": "application/xml"}, _xml(root)
+
+    async def _preflight(self, target: str,
+                         headers: dict) -> tuple[int, dict, bytes]:
+        """OPTIONS preflight (rgw_cors RGWOptionsCORS role):
+        unauthenticated by design — browsers send no credentials."""
+        origin = headers.get("origin", "")
+        acrm = headers.get("access-control-request-method", "")
+        if not origin or not acrm:
+            raise RGWError("InvalidRequest", 403)
+        path = urllib.parse.unquote(
+            urllib.parse.urlsplit(target).path)
+        parts = [p for p in path.split("/") if p]
+        if not parts:
+            raise RGWError("InvalidRequest", 403)
+        rules = await self._cors_rules(parts[0])
+        req_hdrs = [h.strip() for h in headers.get(
+            "access-control-request-headers", "").split(",")
+            if h.strip()]
+        allow = RGWLite.cors_match(rules, origin, acrm, req_hdrs)
+        if allow is None:
+            raise RGWError("AccessForbidden", 403)
+        return 200, allow, b""
 
     async def _list_versions(self, bucket: str, query: dict):
         vers = await self.rgw.list_object_versions(

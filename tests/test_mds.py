@@ -431,3 +431,74 @@ def test_foreign_truncate_invalidates_cached_reader():
         await c.stop()
 
     run(t())
+
+
+def test_quotas_files_and_bytes():
+    """ceph.quota.max_files (MDS-enforced on create/mkdir) and
+    max_bytes (client-enforced on growing writes), realm nesting,
+    rstat surface, and clearing."""
+    import ceph_tpu.services.fs as fslib
+
+    async def t():
+        c, mds, a, b = await make()
+        await a.mkdir("/q")
+        await a.set_quota("/q", max_files=3)
+        await a.create("/q/f1")
+        await a.create("/q/f2")
+        await a.mkdir("/q/sub")  # 3rd entry hits the limit
+        with pytest.raises(fslib.QuotaExceeded):
+            await a.create("/q/f3")
+        # enforcement is realm-wide: the OTHER client hits it too,
+        # and nested dirs count against the same realm
+        with pytest.raises(fslib.QuotaExceeded):
+            await b.create("/q/sub/nested")
+        # outside the realm creation is free
+        await a.create("/free")
+        # lift the file quota, set a byte quota
+        await a.set_quota("/q", max_bytes=4096)
+        await a.create("/q/f3")
+        await a.write("/q/f3", b"x" * 2048)
+        await a._flush(a._paths["/q/f3"])
+        b._quota_cache.clear()
+        with pytest.raises(fslib.QuotaExceeded):
+            await b.write("/q/big", b"y" * 4096)
+        # usage surface (getquota + dirstat)
+        q = await a.get_quota("/q/sub")
+        assert q["realm"] == "/q" and q["max_bytes"] == 4096
+        assert q["rbytes"] >= 2048
+        st = await a.dir_stat("/q")
+        # f1 f2 f3 + the empty "big" left by the rejected write (the
+        # create lands before the byte check, POSIX-style)
+        assert st["rfiles"] == 4 and st["rsubdirs"] == 1
+        assert st["rbytes"] >= 2048
+        # clear the quota: writes flow again
+        await a.set_quota("/q")
+        b._quota_cache.clear()
+        await b.write("/q/big", b"y" * 8192)
+        await c.stop()
+
+    run(t())
+
+
+def test_quota_nested_realms():
+    """A deeper realm with a tighter limit wins for paths under it;
+    the outer realm still governs siblings."""
+    import ceph_tpu.services.fs as fslib
+
+    async def t():
+        c, mds, a, b = await make()
+        await a.mkdir("/outer")
+        await a.mkdir("/outer/inner")
+        await a.set_quota("/outer", max_files=10)
+        await a.set_quota("/outer/inner", max_files=1)
+        await a.create("/outer/inner/one")
+        with pytest.raises(fslib.QuotaExceeded):
+            await a.create("/outer/inner/two")
+        # sibling under the outer realm only: fine
+        for i in range(3):
+            await a.create(f"/outer/s{i}")
+        q = await a.get_quota("/outer/inner/one")
+        assert q["realm"] == "/outer/inner"
+        await c.stop()
+
+    run(t())

@@ -16,7 +16,7 @@ from ceph_tpu.cluster.procstart import ProcCluster
 from ceph_tpu.placement.osdmap import Pool
 
 
-def run(coro, timeout=240):
+def run(coro, timeout=480):
     asyncio.run(asyncio.wait_for(coro, timeout))
 
 
@@ -26,7 +26,7 @@ async def make(tmp, n_osds=3, n_mons=1, auth=False, secure=False):
     await c.start()
     await c.client.create_pool(
         Pool(id=1, name="p", size=3, pg_num=8, crush_rule=0))
-    await c.wait_active(60)
+    await c.wait_active(120)
     return c
 
 
@@ -62,13 +62,13 @@ def test_multiprocess_kill9_and_revive(tmp_path):
             for n, d in data.items():
                 await c.client.write_full(1, n, d)
             c.kill_osd(1, signal.SIGKILL)
-            await c.wait_down(1, 40)
+            await c.wait_down(1, 80)
             # degraded reads AND writes still serve
             for n, d in data.items():
                 assert await c.client.read(1, n) == d
             await c.client.write_full(1, "while-down", b"degraded")
             await c.revive_osd(1)
-            await c.wait_up(1, 40)
+            await c.wait_up(1, 80)
             await c.wait_active(90)
             for n, d in data.items():
                 assert await c.client.read(1, n) == d
@@ -91,7 +91,7 @@ def test_multiprocess_full_restart_durability(tmp_path):
         c2 = ProcCluster(str(tmp_path), n_osds=3, n_mons=1)
         await c2.start()
         try:
-            await c2.wait_active(60)
+            await c2.wait_active(120)
             assert await c2.client.read(1, "persist") == b"x" * 10_000
             await c2.client.write_full(1, "again", b"second life")
             assert await c2.client.read(1, "again") == b"second life"

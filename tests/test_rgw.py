@@ -618,3 +618,170 @@ def test_presigned_urls():
         await c.stop()
 
     run(t())
+
+
+def test_object_and_bucket_tagging():
+    """S3 tag sets (rgw_tag_s3 role): per-object tags ride the index
+    entry, survive copies and version promotion, and bucket tags live
+    on the bucket attr."""
+    async def t():
+        c, rgw = await make()
+        await rgw.create_bucket("b")
+        await rgw.put_object("b", "k", b"v",
+                             tags={"env": "prod", "team": "storage"})
+        assert await rgw.get_object_tagging("b", "k") == {
+            "env": "prod", "team": "storage"}
+        # replace + delete
+        await rgw.put_object_tagging("b", "k", {"env": "dev"})
+        assert await rgw.get_object_tagging("b", "k") == {"env": "dev"}
+        await rgw.delete_object_tagging("b", "k")
+        assert await rgw.get_object_tagging("b", "k") == {}
+        # limits
+        with pytest.raises(RGWError) as ei:
+            await rgw.put_object_tagging(
+                "b", "k", {f"t{i}": "x" for i in range(11)})
+        assert ei.value.code == "InvalidTag"
+        with pytest.raises(RGWError) as ei:
+            await rgw.put_object_tagging("b", "k", {"k" * 129: "v"})
+        assert ei.value.code == "InvalidTag"
+        # copy carries the tag set (S3 default COPY directive)
+        await rgw.put_object_tagging("b", "k", {"a": "1"})
+        await rgw.copy_object("b", "k", "b", "k2")
+        assert await rgw.get_object_tagging("b", "k2") == {"a": "1"}
+        # bucket tags
+        await rgw.put_bucket_tagging("b", {"owner": "me"})
+        assert await rgw.get_bucket_tagging("b") == {"owner": "me"}
+        await rgw.delete_bucket_tagging("b")
+        assert await rgw.get_bucket_tagging("b") == {}
+        await c.stop()
+
+    run(t())
+
+
+def test_tagging_versioned_rows():
+    """Tagging a NAMED version updates that row; the current pointer
+    follows only when the named version is current."""
+    async def t():
+        c, rgw = await make()
+        await rgw.create_bucket("b")
+        await rgw.put_bucket_versioning("b", "Enabled")
+        _, v1 = await rgw.put_object("b", "k", b"one")
+        _, v2 = await rgw.put_object("b", "k", b"two")
+        await rgw.put_object_tagging("b", "k", {"gen": "1"},
+                                     version_id=v1)
+        await rgw.put_object_tagging("b", "k", {"gen": "2"},
+                                     version_id=v2)
+        assert await rgw.get_object_tagging("b", "k",
+                                            version_id=v1) == {"gen": "1"}
+        # current (= v2) reflects v2's tags, not v1's
+        assert await rgw.get_object_tagging("b", "k") == {"gen": "2"}
+        # deleting current promotes v1 WITH its tags intact
+        await rgw.delete_object("b", "k", version_id=v2)
+        assert await rgw.get_object_tagging("b", "k") == {"gen": "1"}
+        await c.stop()
+
+    run(t())
+
+
+def test_tagging_and_cors_http_routes():
+    """?tagging / ?cors subresources + OPTIONS preflight over a real
+    socket (s3-tests CORS cases, shrunk)."""
+    async def t():
+        c, rgw = await make()
+        await rgw.create_bucket("web")
+        fe = S3Frontend(rgw)
+        host, port = await fe.start()
+        try:
+            # object tagging via x-amz-tagging header on PUT
+            st, rh, _ = await http(
+                host, port, "PUT", "/web/o", b"data",
+                headers={"x-amz-tagging": "k1=v1&k2=v2"})
+            assert st == 200
+            st, _, body = await http(host, port, "GET",
+                                     "/web/o?tagging")
+            assert st == 200 and b"<Key>k1</Key>" in body \
+                and b"<Value>v2</Value>" in body
+            # PUT ?tagging replaces; DELETE clears
+            xml = (b"<Tagging><TagSet><Tag><Key>env</Key>"
+                   b"<Value>prod</Value></Tag></TagSet></Tagging>")
+            st, _, _ = await http(host, port, "PUT", "/web/o?tagging",
+                                  xml)
+            assert st == 200
+            st, _, body = await http(host, port, "GET",
+                                     "/web/o?tagging")
+            assert b"env" in body and b"k1" not in body
+            st, _, _ = await http(host, port, "DELETE",
+                                  "/web/o?tagging")
+            assert st == 204
+            # GET object advertises the tag count
+            st, rh, _ = await http(host, port, "PUT", "/web/o2", b"x",
+                                   headers={"x-amz-tagging": "a=1"})
+            st, rh, _ = await http(host, port, "GET", "/web/o2")
+            assert rh.get("x-amz-tagging-count") == "1"
+            # bucket tagging
+            st, _, _ = await http(
+                host, port, "PUT", "/web?tagging",
+                b"<Tagging><TagSet><Tag><Key>t</Key><Value>b</Value>"
+                b"</Tag></TagSet></Tagging>")
+            assert st == 204
+            st, _, body = await http(host, port, "GET",
+                                     "/web?tagging")
+            assert st == 200 and b"<Key>t</Key>" in body
+            # CORS config
+            cors = (b"<CORSConfiguration><CORSRule>"
+                    b"<AllowedOrigin>https://*.example.com"
+                    b"</AllowedOrigin>"
+                    b"<AllowedMethod>GET</AllowedMethod>"
+                    b"<AllowedHeader>*</AllowedHeader>"
+                    b"<ExposeHeader>etag</ExposeHeader>"
+                    b"<MaxAgeSeconds>300</MaxAgeSeconds>"
+                    b"</CORSRule></CORSConfiguration>")
+            st, _, _ = await http(host, port, "PUT", "/web?cors", cors)
+            assert st == 200
+            st, _, body = await http(host, port, "GET", "/web?cors")
+            assert st == 200 and b"AllowedOrigin" in body
+            # preflight: matching origin+method allowed
+            st, rh, _ = await http(
+                host, port, "OPTIONS", "/web/o",
+                headers={"origin": "https://app.example.com",
+                         "access-control-request-method": "GET",
+                         "access-control-request-headers":
+                             "x-custom"})
+            assert st == 200
+            assert rh["access-control-allow-origin"] \
+                == "https://app.example.com"
+            assert rh["access-control-max-age"] == "300"
+            # preflight: method not allowed -> 403
+            st, _, _ = await http(
+                host, port, "OPTIONS", "/web/o",
+                headers={"origin": "https://app.example.com",
+                         "access-control-request-method": "DELETE"})
+            assert st == 403
+            # preflight: origin not allowed -> 403
+            st, _, _ = await http(
+                host, port, "OPTIONS", "/web/o",
+                headers={"origin": "https://evil.com",
+                         "access-control-request-method": "GET"})
+            assert st == 403
+            # simple cross-origin GET gets the allow + expose headers
+            st, rh, _ = await http(
+                host, port, "GET", "/web/o2",
+                headers={"origin": "https://app.example.com"})
+            assert rh.get("access-control-allow-origin") \
+                == "https://app.example.com"
+            assert rh.get("access-control-expose-headers") == "etag"
+            # DELETE ?cors; preflight then refuses
+            st, _, _ = await http(host, port, "DELETE", "/web?cors")
+            assert st == 204
+            st, _, body = await http(host, port, "GET", "/web?cors")
+            assert st == 404
+            st, _, _ = await http(
+                host, port, "OPTIONS", "/web/o",
+                headers={"origin": "https://app.example.com",
+                         "access-control-request-method": "GET"})
+            assert st == 403
+        finally:
+            await fe.stop()
+            await c.stop()
+
+    run(t())
