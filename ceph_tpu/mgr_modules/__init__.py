@@ -9,15 +9,19 @@ external ones from any directory.
 from __future__ import annotations
 
 from .balancer import Module as BalancerModule
+from .crash import Module as CrashModule
 from .dashboard import Module as DashboardModule
 from .pg_autoscaler import Module as PgAutoscalerModule
 from .prometheus import Module as PrometheusModule
 from .rgw_lc import Module as RgwLcModule
+from .telemetry import Module as TelemetryModule
 
 BUILTIN = {
     "balancer": BalancerModule,
+    "crash": CrashModule,
     "dashboard": DashboardModule,
     "pg_autoscaler": PgAutoscalerModule,
     "prometheus": PrometheusModule,
     "rgw_lc": RgwLcModule,
+    "telemetry": TelemetryModule,
 }

@@ -159,6 +159,10 @@ class MDSLite:
         self._seq = 0
         self._jbytes = 0
         self._lock = asyncio.Lock()  # serializes journaled mutations
+        #: quota caches (see _quota_check_files): parent dir ->
+        #: (expiry, nearest realm), realm -> (expiry, entry count)
+        self._realm_cache: dict[str, tuple[float, object]] = {}
+        self._realm_count_cache: dict[str, tuple[float, int]] = {}
         #: ino -> path recorded at open/create (cap flush needs the
         #: dentry location)
         self._open_paths: dict[int, str] = {}
@@ -777,6 +781,8 @@ class MDSLite:
                 path,
                 max_bytes=denc.dec_u64(args["max_bytes"], 0)[0],
                 max_files=denc.dec_u64(args["max_files"], 0)[0])
+            self._realm_cache.clear()
+            self._realm_count_cache.clear()
             return {}
         if verb == "create":
             ent = None
@@ -864,18 +870,39 @@ class MDSLite:
         """EDQUOT when creating one more entry would pass the nearest
         realm's max_files (MDS-side file-count enforcement; byte
         quotas are enforced client-side like the reference, since data
-        writes never pass through the MDS)."""
+        writes never pass through the MDS).
+
+        Both the realm lookup (per-ancestor getxattr) and the subtree
+        entry count (full BFS) are cached briefly and self-advanced on
+        each accepted create — without this, filling a realm is
+        O(N^2) in omap round trips and even quota-free trees pay a
+        per-create ancestor walk. setquota clears both caches."""
         parent = _norm(path).rsplit("/", 1)[0] or "/"
-        realm = await self.fs.nearest_quota(parent)
+        now = time.monotonic()
+        hit = self._realm_cache.get(parent)
+        if hit is not None and now < hit[0]:
+            realm = hit[1]
+        else:
+            realm = await self.fs.nearest_quota(parent)
+            self._realm_cache[parent] = (now + 2.0, realm)
+            if len(self._realm_cache) > 4096:
+                self._realm_cache.clear()
         if realm is None:
             return
         rpath, q = realm
         if not q.get("max_files"):
             return
-        _rb, rf, rd = await self.fs.subtree_stats(rpath)
-        if rf + rd >= q["max_files"]:
+        sh = self._realm_count_cache.get(rpath)
+        if sh is not None and now < sh[0]:
+            count = sh[1]
+        else:
+            _rb, rf, rd = await self.fs.subtree_stats(rpath)
+            count = rf + rd
+        if count >= q["max_files"]:
             raise fslib.QuotaExceeded(
-                f"{rpath}: {rf + rd} >= max_files {q['max_files']}")
+                f"{rpath}: {count} >= max_files {q['max_files']}")
+        # account the entry this check just admitted
+        self._realm_count_cache[rpath] = (now + 2.0, count + 1)
 
     async def _apply_mksnap(self, dir_ino: int, name: str,
                             sid: int) -> None:
@@ -1384,12 +1411,9 @@ class FSClient:
                 ino = await self.open(path, "w")
             except fslib.NoEnt:
                 ino = await self.create(path)
-        prev = self.wcaps.get(ino)
-        if prev is None:
-            try:
-                prev = (await self.stat(path))["size"]
-            except fslib.FSError:
-                prev = 0
+        # open("w")/create always seeded wcaps with the server size,
+        # so prev is the authoritative pre-write size
+        prev = self.wcaps[ino]
         await self._quota_check_bytes(
             path, offset + len(data) - prev)
         await self.striper.write(fslib._data_name(ino), data, offset,

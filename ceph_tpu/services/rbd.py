@@ -37,6 +37,7 @@ from __future__ import annotations
 
 import asyncio
 import secrets
+import time
 
 from ..osdc.striper import (
     FileLayout,
@@ -64,6 +65,7 @@ ATTR_PARENT = "rbd.parent"  # "name@snap" of the clone source
 LOCK_NAME = "rbd_lock"  # the cls lock name (librbd RBD_LOCK_NAME)
 NOTIFY_REQUEST_LOCK = b"request_lock"
 ATTR_OMAP_BITS = "rbd.objectmap"  # 1 byte/object: 1 = exists
+ATTR_GROUP = "rbd.group"  # consistency-group back-pointer
 ATTR_MIGRATING = "rbd.migrating"  # on the SOURCE: "pool/dst" target
 ATTR_MIGRATION_SOURCE = "rbd.migration_source"  # on the DST: "pool/src"
 ATTR_MIGRATION_EXECUTED = "rbd.migration_executed"
@@ -186,6 +188,10 @@ class RBD:
               .setxattr(ATTR_LAYOUT, _enc_layout(layout))
               .setxattr(ATTR_SNAPS, _enc_snaps([]))
               .setxattr(ATTR_SNAPSEQ, denc.enc_u64(0)))
+        if await self._trash_reserved(name):
+            # a trashed image's data objects still carry this name —
+            # a fresh image would silently share them (see trash note)
+            raise ImageExists(f"{name} (reserved by trash)")
         try:
             await self.client.operate(self.pool_id, _header(name), op)
         except IOError as e:
@@ -216,10 +222,20 @@ class RBD:
             for oid in await self.client.list_objects(self.pool_id)
             if oid.startswith(prefix))
 
+    async def _image_group(self, name: str) -> str:
+        try:
+            hdr = await self.client.getxattrs(self.pool_id,
+                                              _header(name))
+        except KeyError:
+            return ""
+        return hdr.get(ATTR_GROUP, b"").decode()
+
     async def remove(self, name: str) -> None:
         img = await self.open(name)
         if img.snaps:
             raise RuntimeError(f"image {name} has snapshots")
+        if await self._image_group(name):
+            raise RuntimeError(f"image {name} is in a group")
         await img.acquire_lock()  # loads/rebuilds the object map
         async with img._io_guard():
             await img._remove_objects()
@@ -229,6 +245,348 @@ class RBD:
         except KeyError:
             pass
         await self.client.delete(self.pool_id, _header(name))
+
+    # --------------------------------------------------------------- trash
+    #
+    # librbd Trash.cc role. Data objects are keyed by image NAME here
+    # (the reference keys by immutable id), so a trashed image's name
+    # stays RESERVED (create() refuses it) until restore or purge —
+    # otherwise a new same-name image would share rbd_data.<name>.*
+    # with the corpse. Restore is therefore to the original name only.
+
+    TRASH_DIR = "rbd_trash"
+
+    @staticmethod
+    def _trash_header(tid: str) -> str:
+        return f"rbd_trash_header.{tid}"
+
+    @staticmethod
+    def _enc_trash(name: str, ts: float, defer_end: float) -> bytes:
+        return (denc.enc_str(name) + denc.enc_u64(int(ts))
+                + denc.enc_u64(int(defer_end)))
+
+    @staticmethod
+    def _dec_trash(b: bytes) -> dict:
+        name, off = denc.dec_str(b, 0)
+        ts, off = denc.dec_u64(b, off)
+        de, _ = denc.dec_u64(b, off)
+        return {"name": name, "trashed_at": ts, "defer_end": de}
+
+    async def _trash_entries(self) -> dict[bytes, bytes]:
+        try:
+            return await self.client.omap_get(self.pool_id,
+                                              self.TRASH_DIR)
+        except KeyError:
+            return {}
+
+    async def _trash_reserved(self, name: str) -> bool:
+        return any(self._dec_trash(v)["name"] == name
+                   for v in (await self._trash_entries()).values())
+
+    async def trash_move(self, name: str, delay_s: float = 0.0) -> str:
+        """Defer-delete an image (`rbd trash mv`): the header moves
+        aside, the image vanishes from `list`, data stays. Returns the
+        trash id. ``delay_s`` sets the deferment window `trash rm`
+        honors without --force."""
+        # open() validates existence and refuses mid-migration images
+        img = await self.open(name)
+        if await self._image_group(name):
+            raise RuntimeError(f"image {name} is in a group")
+        # fence live writers like remove() does: the exclusive lock is
+        # taken (stealing from dead holders) before the header goes —
+        # otherwise a holder would keep mutating the corpse's data
+        # objects and its lock record would die with the header
+        await img.acquire_lock()
+        try:
+            xattrs = await self.client.getxattrs(self.pool_id,
+                                                 _header(name))
+            now = time.time()
+            tid = secrets.token_hex(8)
+            from ..cluster.client import ObjectOperation
+
+            op = ObjectOperation().create()
+            for k, v in xattrs.items():
+                if k.startswith("lock."):
+                    # never preserve cls lock state: the restored
+                    # image must come back unlocked, not haunted by
+                    # this (about-to-die) handle's ownership record
+                    continue
+                op = op.setxattr(k, v)
+            await self.client.operate(self.pool_id,
+                                      self._trash_header(tid), op)
+            await self.client.omap_set(
+                self.pool_id, self.TRASH_DIR,
+                {tid.encode():
+                 self._enc_trash(name, now, now + delay_s)})
+            # the dir entry is durable before the visible name
+            # disappears: a crash between the two leaves both headers,
+            # restore wins
+            await self.client.delete(self.pool_id, _header(name))
+        finally:
+            try:
+                await img.release_lock()
+            except Exception:
+                pass  # the lock record went with the header
+        return tid
+
+    async def trash_list(self) -> list[dict]:
+        out = []
+        for k, v in sorted((await self._trash_entries()).items()):
+            ent = self._dec_trash(v)
+            ent["id"] = k.decode()
+            out.append(ent)
+        return out
+
+    async def _trash_materialize(self, tid: str) -> str:
+        """Recreate the live header from the trash header (no
+        directory-entry change); returns the original name."""
+        ents = await self._trash_entries()
+        raw = ents.get(tid.encode())
+        if raw is None:
+            raise ImageNotFound(tid)
+        name = self._dec_trash(raw)["name"]
+        xattrs = await self.client.getxattrs(
+            self.pool_id, self._trash_header(tid))
+        from ..cluster.client import ObjectOperation
+
+        op = ObjectOperation().create(exclusive=False)
+        for k, v in xattrs.items():
+            op = op.setxattr(k, v)
+        await self.client.operate(self.pool_id, _header(name), op)
+        return name
+
+    async def _trash_drop_entry(self, tid: str) -> None:
+        try:
+            await self.client.delete(self.pool_id,
+                                     self._trash_header(tid))
+        except KeyError:
+            pass
+        await self.client.omap_rm(self.pool_id, self.TRASH_DIR,
+                                  [tid.encode()])
+
+    async def trash_restore(self, tid: str) -> str:
+        """`rbd trash restore`: the header returns under its original
+        name (reserved meanwhile, so it cannot be taken)."""
+        name = await self._trash_materialize(tid)
+        await self._trash_drop_entry(tid)
+        return name
+
+    async def trash_remove(self, tid: str, force: bool = False) -> None:
+        """`rbd trash rm`: delete the image + its data for good;
+        refuses inside the deferment window unless forced."""
+        ents = await self._trash_entries()
+        raw = ents.get(tid.encode())
+        if raw is None:
+            raise ImageNotFound(tid)
+        ent = self._dec_trash(raw)
+        if not force and time.time() < ent["defer_end"]:
+            raise RuntimeError(
+                f"{ent['name']} deferred until {ent['defer_end']}")
+        # materialize under the (reserved) original name so the normal
+        # removal path tears down data + object map + header — but the
+        # TRASH ENTRY is dropped only after the teardown succeeds: a
+        # failure mid-removal must leave the image findable in trash
+        # (retryable), never silently resurrected as live
+        name = await self._trash_materialize(tid)
+        img = await self.open(name)
+        for s in list(img.snaps):
+            await img.snap_remove(s)
+        await self.remove(name)
+        await self._trash_drop_entry(tid)
+
+    async def trash_purge(self) -> list[str]:
+        """Remove every trash entry whose deferment has passed."""
+        removed = []
+        now = time.time()
+        for ent in await self.trash_list():
+            if now >= ent["defer_end"]:
+                await self.trash_remove(ent["id"])
+                removed.append(ent["name"])
+        return removed
+
+    # -------------------------------------------------------------- groups
+    #
+    # librbd api/Group.cc + cls_rbd group directory role: a pool-level
+    # directory object maps group name -> group object; the group
+    # object's omap holds members ("image.<name>") and group snapshots
+    # ("snap.<name>" -> [(image, image-snap)]).
+
+    GROUP_DIR = "rbd_group_directory"
+
+    @staticmethod
+    def _group_oid(group: str) -> str:
+        return f"rbd_group.{group}"
+
+    async def _group_members(self, group: str) -> list[str]:
+        dirmap = await self._group_dir()
+        if group.encode() not in dirmap:
+            raise ImageNotFound(f"group {group}")
+        try:
+            omap = await self.client.omap_get(self.pool_id,
+                                              self._group_oid(group))
+        except KeyError:
+            return []
+        return sorted(k.decode()[6:] for k in omap
+                      if k.startswith(b"image."))
+
+    async def _group_dir(self) -> dict[bytes, bytes]:
+        try:
+            return await self.client.omap_get(self.pool_id,
+                                              self.GROUP_DIR)
+        except KeyError:
+            return {}
+
+    async def group_create(self, group: str) -> None:
+        if group.encode() in await self._group_dir():
+            raise ImageExists(f"group {group}")
+        await self.client.write_full(self.pool_id,
+                                     self._group_oid(group), b"")
+        await self.client.omap_set(self.pool_id, self.GROUP_DIR,
+                                   {group.encode(): b""})
+
+    async def group_list(self) -> list[str]:
+        return sorted(k.decode() for k in await self._group_dir())
+
+    async def group_remove(self, group: str) -> None:
+        """Remove a group; member images are detached (their group
+        back-pointer clears), group snapshots must be removed first."""
+        for snap in await self.group_snap_list(group):
+            raise RuntimeError(
+                f"group {group} has snapshot {snap['name']}")
+        for name in await self._group_members(group):
+            await self.group_image_remove(group, name)
+        await self.client.delete(self.pool_id, self._group_oid(group))
+        await self.client.omap_rm(self.pool_id, self.GROUP_DIR,
+                                  [group.encode()])
+
+    async def group_image_add(self, group: str, name: str) -> None:
+        await self._group_members(group)  # group must exist
+        hdr = await self.client.getxattrs(self.pool_id, _header(name))
+        if ATTR_GROUP in hdr and hdr[ATTR_GROUP].decode():
+            raise ImageExists(
+                f"{name} already in group {hdr[ATTR_GROUP].decode()}")
+        await self.client.setxattr(self.pool_id, _header(name),
+                                   ATTR_GROUP, group.encode())
+        await self.client.omap_set(self.pool_id,
+                                   self._group_oid(group),
+                                   {b"image." + name.encode(): b""})
+
+    async def group_image_remove(self, group: str, name: str) -> None:
+        await self._group_members(group)
+        await self.client.omap_rm(self.pool_id, self._group_oid(group),
+                                  [b"image." + name.encode()])
+        try:
+            await self.client.setxattr(self.pool_id, _header(name),
+                                       ATTR_GROUP, b"")
+        except KeyError:
+            pass  # image already deleted
+
+    async def group_image_list(self, group: str) -> list[str]:
+        return await self._group_members(group)
+
+    async def group_snap_create(self, group: str, snap: str) -> None:
+        """Crash-consistent snapshot across every member: exclusive
+        locks on ALL members are taken first (sorted — no ABBA), so no
+        writer mutates any member between the first and last image
+        snap (the group quiesce barrier of api/Group.cc)."""
+        members = await self._group_members(group)
+        key = b"snap." + snap.encode()
+        omap = await self.client.omap_get(self.pool_id,
+                                          self._group_oid(group))
+        if key in omap:
+            raise ImageExists(f"{group}@{snap}")
+        imgs = []
+        pairs: list[tuple[str, str]] = []
+        try:
+            for name in members:  # sorted by _group_members
+                img = await self.open(name)
+                await img.acquire_lock()
+                imgs.append(img)
+            for img in imgs:
+                isnap = f".group.{group}.{snap}"
+                await img.snap_create(isnap)
+                pairs.append((img.name, isnap))
+            await self.client.omap_set(
+                self.pool_id, self._group_oid(group),
+                {key: denc.enc_list(
+                    pairs, lambda p: denc.enc_str(p[0])
+                    + denc.enc_str(p[1]))})
+            pairs = []  # committed: nothing to unwind
+        finally:
+            # partial failure: roll back already-taken member snaps,
+            # or a retry would hit snapshot-exists forever with no
+            # group entry recording the orphans
+            for img in imgs:
+                taken = next((s for n, s in pairs if n == img.name),
+                             None)
+                if taken is not None:
+                    try:
+                        await img.snap_remove(taken)
+                    except Exception:
+                        pass
+                try:
+                    await img.release_lock()
+                except Exception:
+                    pass
+
+    async def group_snap_list(self, group: str) -> list[dict]:
+        await self._group_members(group)
+        try:
+            omap = await self.client.omap_get(self.pool_id,
+                                              self._group_oid(group))
+        except KeyError:
+            return []
+        out = []
+
+        def one(b, o):
+            img, o = denc.dec_str(b, o)
+            sn, o = denc.dec_str(b, o)
+            return (img, sn), o
+
+        for k, v in sorted(omap.items()):
+            if not k.startswith(b"snap."):
+                continue
+            pairs, _ = denc.dec_list(v, 0, one)
+            out.append({"name": k[5:].decode(), "members": pairs})
+        return out
+
+    async def group_snap_remove(self, group: str, snap: str) -> None:
+        for ent in await self.group_snap_list(group):
+            if ent["name"] != snap:
+                continue
+            for img_name, isnap in ent["members"]:
+                try:
+                    img = await self.open(img_name)
+                    await img.snap_remove(isnap)
+                except (ImageNotFound, KeyError):
+                    pass  # member deleted since the snap
+            await self.client.omap_rm(
+                self.pool_id, self._group_oid(group),
+                [b"snap." + snap.encode()])
+            return
+        raise KeyError(snap)
+
+    async def group_snap_rollback(self, group: str, snap: str) -> None:
+        """Roll every member back to the group snapshot, under the
+        same all-member lock barrier as create."""
+        ent = next((e for e in await self.group_snap_list(group)
+                    if e["name"] == snap), None)
+        if ent is None:
+            raise KeyError(snap)
+        imgs = []
+        try:
+            for img_name, _ in sorted(ent["members"]):
+                img = await self.open(img_name)
+                await img.acquire_lock()
+                imgs.append(img)
+            for img, (_n, isnap) in zip(imgs, sorted(ent["members"])):
+                await img.snap_rollback(isnap)
+        finally:
+            for img in imgs:
+                try:
+                    await img.release_lock()
+                except Exception:
+                    pass
 
     async def clone(self, parent: str, snap: str, child: str) -> None:
         """Layered child image backed by parent@snap (librbd clone

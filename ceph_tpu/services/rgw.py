@@ -1399,6 +1399,11 @@ class S3Frontend(HttpFrontend):
             rules = await self.rgw.get_bucket_cors(bucket)
         except RGWError:
             rules = []
+        if len(self._cors_cache) >= 1024:
+            # bounded: bucket names here are attacker-controlled via
+            # the unauthenticated OPTIONS path — an unbounded dict
+            # would be a memory-exhaustion vector
+            self._cors_cache.pop(next(iter(self._cors_cache)))
         self._cors_cache[bucket] = (now + 5.0, rules)
         return rules
 

@@ -252,3 +252,64 @@ def test_osd_bench_admin_command(tmp_path):
         await c.stop()
 
     run(t())
+
+
+def test_crash_module():
+    """crash mgr module: post/ls/info/rm/prune + recent summary,
+    persisted in the mon-backed module store."""
+    async def t():
+        c = await make()
+        out = await c.mgr.dispatch_command(
+            "crash post", {"entity": "osd.2",
+                           "backtrace": "0x1 raise\n0x2 abort"})
+        cid = out["crash_id"]
+        ls = await c.mgr.dispatch_command("crash ls", {})
+        assert [e["crash_id"] for e in ls] == [cid]
+        info = await c.mgr.dispatch_command("crash info", {"id": cid})
+        assert info["entity_name"] == "osd.2" \
+            and "abort" in info["backtrace"]
+        stat = await c.mgr.dispatch_command("crash stat", {})
+        assert stat == {"total": 1, "recent": 1,
+                        "health": "RECENT_CRASH"}
+        # an ancient crash prunes; the fresh one survives
+        import time as _t
+        old = await c.mgr.dispatch_command(
+            "crash post", {"entity": "osd.0",
+                           "ts": _t.time() - 30 * 86400})
+        out = await c.mgr.dispatch_command("crash prune",
+                                           {"keep_days": 14})
+        assert out == {"removed": 1}
+        ls = await c.mgr.dispatch_command("crash ls", {})
+        assert [e["crash_id"] for e in ls] == [cid]
+        await c.mgr.dispatch_command("crash rm", {"id": cid})
+        assert await c.mgr.dispatch_command("crash ls", {}) == []
+        assert old["crash_id"]  # only shape-used above
+        await c.stop()
+
+    run(t())
+
+
+def test_telemetry_module():
+    """telemetry mgr module: opt-in state machine + anonymized report
+    (shapes and counts, no pool names)."""
+    async def t():
+        c = await make()
+        st = await c.mgr.dispatch_command("telemetry status", {})
+        assert st == {"enabled": False, "last_report_at": None}
+        rep = await c.mgr.dispatch_command("telemetry show", {})
+        assert rep["osd"]["count"] == 4
+        assert rep["pools"] and rep["pools"][0]["size"] == 3
+        # anonymized: no pool names anywhere in the report
+        import json as _json
+        assert "'p'" not in str(rep) and '"p"' not in _json.dumps(rep)
+        await c.mgr.dispatch_command("telemetry on", {})
+        out = await c.mgr.dispatch_command("telemetry send", {})
+        assert out["sent"]
+        st = await c.mgr.dispatch_command("telemetry status", {})
+        assert st["enabled"] and st["last_report_at"] is not None
+        await c.mgr.dispatch_command("telemetry off", {})
+        st = await c.mgr.dispatch_command("telemetry status", {})
+        assert not st["enabled"]
+        await c.stop()
+
+    run(t())

@@ -537,3 +537,99 @@ def test_retained_bytes_matches_extent_enumeration():
         for objno in range(hi):
             assert retained_bytes(lo, upto, objno) == \
                 want.get(objno, 0), (lo, upto, objno)
+
+
+def test_trash_lifecycle():
+    """rbd trash mv/ls/restore/rm/purge: deferred delete with the
+    name reserved while trashed (data objects are name-keyed here)."""
+    async def t():
+        c, rbd = await make()
+        await rbd.create("disk", 32 * 1024, LAYOUT)
+        img = await rbd.open("disk")
+        await img.write(0, b"precious" * 512)
+        await img.release_lock()
+        tid = await rbd.trash_move("disk", delay_s=3600)
+        assert await rbd.list() == []
+        ents = await rbd.trash_list()
+        assert len(ents) == 1 and ents[0]["name"] == "disk" \
+            and ents[0]["id"] == tid
+        # the name is reserved while trashed
+        with pytest.raises(ImageExists):
+            await rbd.create("disk", 1024)
+        # inside the deferment window rm refuses without force
+        with pytest.raises(RuntimeError):
+            await rbd.trash_remove(tid)
+        # restore brings the image back intact
+        assert await rbd.trash_restore(tid) == "disk"
+        img = await rbd.open("disk")
+        assert (await img.read(0, 8))[:8] == b"precious"
+        await img.release_lock()
+        assert await rbd.trash_list() == []
+        # trash again and force-remove: data really gone
+        tid = await rbd.trash_move("disk")
+        await rbd.trash_remove(tid, force=True)
+        assert await rbd.list() == []
+        assert await rbd.trash_list() == []
+        await rbd.create("disk", 1024)  # name free again
+        # purge honors deferment
+        await rbd.create("short", 4096, LAYOUT)
+        await rbd.create("long", 4096, LAYOUT)
+        await rbd.trash_move("short")
+        await rbd.trash_move("long", delay_s=3600)
+        assert await rbd.trash_purge() == ["short"]
+        assert [e["name"] for e in await rbd.trash_list()] == ["long"]
+        await c.stop()
+
+    run(t())
+
+
+def test_groups_and_group_snapshots():
+    """Consistency groups: membership, the all-member lock barrier on
+    group snapshots, and group rollback."""
+    async def t():
+        c, rbd = await make()
+        await rbd.create("a", 16 * 1024, LAYOUT)
+        await rbd.create("b", 16 * 1024, LAYOUT)
+        await rbd.group_create("g")
+        with pytest.raises(ImageExists):
+            await rbd.group_create("g")
+        assert await rbd.group_list() == ["g"]
+        await rbd.group_image_add("g", "a")
+        await rbd.group_image_add("g", "b")
+        with pytest.raises(ImageExists):  # already in a group
+            await rbd.group_image_add("g", "a")
+        assert await rbd.group_image_list("g") == ["a", "b"]
+        # a grouped image cannot be removed or trashed
+        with pytest.raises(RuntimeError):
+            await rbd.remove("a")
+        with pytest.raises(RuntimeError):
+            await rbd.trash_move("a")
+        # write state, snap the group, overwrite, roll back
+        ia = await rbd.open("a")
+        ib = await rbd.open("b")
+        await ia.write(0, b"A1" * 100)
+        await ib.write(0, b"B1" * 100)
+        await ia.release_lock()
+        await ib.release_lock()
+        await rbd.group_snap_create("g", "s1")
+        snaps = await rbd.group_snap_list("g")
+        assert snaps[0]["name"] == "s1" \
+            and len(snaps[0]["members"]) == 2
+        # member images carry the per-image group snap
+        ia = await rbd.open("a")
+        assert any(s.startswith(".group.g.") for s in ia.snaps)
+        await ia.write(0, b"A2" * 100)
+        await ia.release_lock()
+        await rbd.group_snap_rollback("g", "s1")
+        ia = await rbd.open("a")
+        assert (await ia.read(0, 4)) == b"A1A1"
+        await ia.release_lock()
+        # snap removal then group teardown
+        await rbd.group_snap_remove("g", "s1")
+        assert await rbd.group_snap_list("g") == []
+        await rbd.group_remove("g")
+        assert await rbd.group_list() == []
+        await rbd.remove("a")  # detached: removable again
+        await c.stop()
+
+    run(t())
