@@ -10,11 +10,12 @@ write_full, read, stat, delete (src/librados/IoCtxImpl.cc:589-668).
 from __future__ import annotations
 
 import asyncio
+import functools
 from dataclasses import dataclass, field
 
 from ..placement import encoding as menc
 from ..placement.osdmap import PlacementMemo, Pool
-from ..utils import trace
+from ..utils import denc, trace
 from . import messages as M
 
 
@@ -373,6 +374,10 @@ class RadosClient:
         return sorted(names)
 
     # ------------------------------------------------------------ surface
+
+    def ioctx(self, pool_id: int, nspace: str = "") -> "IoCtx":
+        """Namespace-scoped view (rados_ioctx_set_namespace role)."""
+        return IoCtx(self, pool_id, nspace)
 
     async def mon_command(self, cmd: dict | list,
                           ) -> tuple[int, str, bytes]:
@@ -740,3 +745,96 @@ class ObjectOperation:
         (ObjectOperation::exec role)."""
         return self._add("call", key=f"{cls}.{method}".encode(),
                          data=bytes(inp))
+
+
+# ------------------------------------------------------------ namespaces
+#
+# RADOS object namespaces (rados_ioctx_set_namespace role): an IoCtx
+# scopes every object name to (pool, namespace). The reference carries
+# the nspace as a separate hobject_t field end to end; here the
+# namespace is folded into the oid with a length-prefixed header under
+# one reserved lead byte, so the whole PG/store/recovery path stays
+# untouched. The cost of that simplification: names in the DEFAULT
+# namespace may not begin with the reserved byte (EINVAL, documented
+# divergence — the reference allows any bytes anywhere).
+
+NS_LEAD = b"\x1e"
+
+
+def ns_oid(nspace: str, name: str | bytes) -> bytes:
+    """Fold (namespace, name) into a wire/store oid."""
+    raw = name.encode() if isinstance(name, str) else bytes(name)
+    if not nspace:
+        if raw.startswith(NS_LEAD):
+            raise ValueError(
+                "names in the default namespace must not start with "
+                "0x1e (reserved for namespace-folded oids)")
+        return raw
+    return NS_LEAD + denc.enc_str(nspace) + raw
+
+
+def split_ns(oid: bytes) -> tuple[str, bytes]:
+    """Inverse of ns_oid: oid -> (namespace, bare name)."""
+    if not oid.startswith(NS_LEAD):
+        return "", oid
+    ns, off = denc.dec_str(oid, 1)
+    return ns, oid[off:]
+
+
+#: RadosClient methods whose second positional argument is an object
+#: name the IoCtx must scope
+_NAME_METHODS = frozenset((
+    "write_full", "write", "append", "truncate", "zero", "read",
+    "stat", "delete", "operate", "getxattr", "setxattr", "rmxattr",
+    "getxattrs", "omap_set", "omap_get", "omap_rm", "watch",
+    "unwatch", "notify", "execute",
+))
+
+
+class IoCtx:
+    """Namespace-scoped view of a RadosClient (librados IoCtx +
+    set_namespace role). Mirrors the client surface; object names are
+    folded into the namespace transparently, and listings are filtered
+    to the namespace (LIBRADOS_ALL_NSPACES via ``all_nspaces=True``)."""
+
+    def __init__(self, client: "RadosClient", pool_id: int,
+                 nspace: str = ""):
+        self._client = client
+        self.pool_id = pool_id
+        self.nspace = nspace
+
+    def __getattr__(self, attr):
+        fn = getattr(self._client, attr)
+        if attr not in _NAME_METHODS:
+            return fn
+        ns = self.nspace
+
+        @functools.wraps(fn)
+        async def scoped(pool_id, name, *a, **kw):
+            return await fn(pool_id, ns_oid(ns, name), *a, **kw)
+
+        return scoped
+
+    def ioctx(self, pool_id: int, nspace: str = "") -> "IoCtx":
+        return IoCtx(self._client, pool_id, nspace)
+
+    async def list_objects(self, pool_id: int,
+                           all_nspaces: bool = False) -> list[bytes]:
+        """Bare names in this IoCtx's namespace; ``all_nspaces``
+        returns raw folded oids across every namespace."""
+        raw = await self._client.list_objects(pool_id)
+        if all_nspaces:
+            return raw
+        out = []
+        for oid in raw:
+            ns, bare = split_ns(oid)
+            if ns == self.nspace:
+                out.append(bare)
+        return out
+
+    async def list_namespaces(self, pool_id: int) -> list[str]:
+        """Distinct namespaces with at least one object (the
+        rados_nobjects_list ALL_NSPACES sweep)."""
+        seen = {split_ns(o)[0]
+                for o in await self._client.list_objects(pool_id)}
+        return sorted(seen)

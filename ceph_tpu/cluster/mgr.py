@@ -87,19 +87,24 @@ class MgrLite(ModuleHost):
         double-counting usage (and falsely tripping quotas)."""
         pg_states: dict[str, int] = {}
         pools: dict[str, list[int]] = {}
+        osds: dict[str, list[int]] = {}  # osd -> [bytes, pg instances]
         ops = 0
         osdmap = self.mon.osdmap
         for o, rep in self.reports.items():
             if not (0 <= o < osdmap.n_osds and osdmap.osds[o].up):
                 continue
+            per_osd = osds.setdefault(str(o), [0, 0])
             for state, n in rep["pgs"].items():
                 pg_states[state] = pg_states.get(state, 0) + n
+                per_osd[1] += n
             for pid, (b, ob) in rep.get("pools", {}).items():
                 ent = pools.setdefault(pid, [0, 0])
                 ent[0] += b
                 ent[1] += ob
+                per_osd[0] += b
             ops += int(rep["perf"].get("op", 0))
-        return {"pg_states": pg_states, "pools": pools, "ops": ops}
+        return {"pg_states": pg_states, "pools": pools, "osds": osds,
+                "ops": ops}
 
     async def stop(self) -> None:
         await self._stop_all_modules()

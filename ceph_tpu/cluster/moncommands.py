@@ -379,6 +379,53 @@ async def _cmd_osd_reweight(mon, cmd):
     return _ok(f"reweighted osd.{i} to {w}")
 
 
+@_command("osd df", "per-osd usage from the mgr digest")
+async def _cmd_osd_df(mon, cmd):
+    dig = getattr(mon, "mgr_digest", None) or {}
+    usage = dig.get("osds", {})
+    rows = []
+    for i, st in enumerate(mon.osdmap.osds):
+        if not st.exists:
+            continue
+        used, pgs = usage.get(str(i), (0, 0))
+        rows.append({"id": i, "status": "up" if st.up else "down",
+                     "reweight": st.weight / 0x10000,
+                     "used_bytes": used, "pgs": pgs})
+    lines = ["ID  STATUS  REWEIGHT  USED      PGS"] + [
+        f"{r['id']:<3} {r['status']:<7} {r['reweight']:<9.4f} "
+        f"{r['used_bytes']:<9} {r['pgs']}" for r in rows]
+    return _ok("\n".join(lines), rows)
+
+
+@_command("osd pg-upmap-items name=pgid,type=str "
+          "name=mappings,type=int,n=N",
+          "pin PG replica replacements: pgid from to [from to ...]")
+async def _cmd_pg_upmap_items(mon, cmd):
+    try:
+        pool_s, _, ps_s = cmd["pgid"].partition(".")
+        pgid = (int(pool_s), int(ps_s))
+    except ValueError:
+        raise ValueError(f"bad pgid {cmd['pgid']!r} (want pool.ps)")
+    if pgid[0] not in mon.osdmap.pools:
+        return (M.ENOENT, f"pool {pgid[0]} does not exist", b"")
+    flat = cmd["mappings"]
+    if len(flat) % 2:
+        raise ValueError("mappings must be from/to pairs")
+    pairs = list(zip(flat[::2], flat[1::2]))
+    await mon._handle_upmap_items(M.MUpmapItems(
+        entries=[(pgid, pairs)]))
+    if pairs:
+        return _ok(f"upmap {cmd['pgid']} {pairs}")
+    return _ok(f"cleared upmap on {cmd['pgid']}")
+
+
+@_command("osd rm-pg-upmap-items name=pgid,type=str",
+          "clear a PG's upmap entry")
+async def _cmd_rm_pg_upmap_items(mon, cmd):
+    cmd = dict(cmd, mappings=[], prefix="osd pg-upmap-items")
+    return await _cmd_pg_upmap_items(mon, cmd)
+
+
 @_command("osd blocklist ls", "list fenced clients")
 async def _cmd_blocklist_ls(mon, cmd):
     bl = sorted(mon.osdmap.blocklist)

@@ -171,11 +171,53 @@ def _enc_lock_input(*fields: str) -> bytes:
 
 
 class RBD:
-    """Pool-level image operations (the librbd::RBD role)."""
+    """Pool-level image operations (the librbd::RBD role).
 
-    def __init__(self, client, pool_id: int):
-        self.client = client
+    ``namespace`` scopes every image (header, data, object map, trash,
+    groups) to a RADOS namespace within the pool (rbd pool namespaces:
+    librbd's RBD_NAMESPACE role) — tenants share a pool without
+    sharing a flat image directory. The namespace registry itself
+    lives in the pool's default namespace."""
+
+    NAMESPACE_DIR = "rbd_namespace"
+
+    def __init__(self, client, pool_id: int, namespace: str = ""):
+        # the raw (default-namespace) client serves the registry; all
+        # image objects ride the scoped IoCtx
+        self._raw = getattr(client, "_client", client)
+        self.namespace = namespace
+        self.client = (client.ioctx(pool_id, namespace) if namespace
+                       else client)
         self.pool_id = pool_id
+
+    # ---------------------------------------------------- namespaces
+
+    async def _namespaces(self) -> dict[bytes, bytes]:
+        try:
+            return await self._raw.omap_get(self.pool_id,
+                                            self.NAMESPACE_DIR)
+        except KeyError:
+            return {}
+
+    async def namespace_create(self, name: str) -> None:
+        if not name:
+            raise ValueError("namespace name must be non-empty")
+        if name.encode() in await self._namespaces():
+            raise ImageExists(f"namespace {name}")
+        await self._raw.omap_set(self.pool_id, self.NAMESPACE_DIR,
+                                 {name.encode(): b""})
+
+    async def namespace_list(self) -> list[str]:
+        return sorted(k.decode() for k in await self._namespaces())
+
+    async def namespace_remove(self, name: str) -> None:
+        if name.encode() not in await self._namespaces():
+            raise ImageNotFound(f"namespace {name}")
+        ns = RBD(self._raw, self.pool_id, namespace=name)
+        if await ns.list() or await ns.trash_list():
+            raise RuntimeError(f"namespace {name} is not empty")
+        await self._raw.omap_rm(self.pool_id, self.NAMESPACE_DIR,
+                                [name.encode()])
 
     async def create(self, name: str, size: int,
                      layout: FileLayout | None = None) -> None:

@@ -271,3 +271,134 @@ def test_pool_rm_drops_pgs_and_objects():
             await c.stop()
 
     run(t())
+
+
+def test_osd_df_and_upmap_commands():
+    async def t():
+        c = await make()
+        try:
+            for i in range(4):
+                await c.client.write_full(1, f"d{i}", b"w" * 1000)
+            rc, outs, outb = await c.client.mon_command(
+                ["osd", "df"])
+            assert rc == 0
+            rows = json.loads(outb)
+            assert len(rows) == 4
+            # stats flow on the digest tick: poll for nonzero usage
+            for _ in range(60):
+                rc, _, outb = await c.client.mon_command(["osd", "df"])
+                rows = json.loads(outb)
+                if sum(r["used_bytes"] for r in rows) > 0:
+                    break
+                await asyncio.sleep(0.25)
+            assert sum(r["used_bytes"] for r in rows) >= 4 * 1000
+            assert all(r["pgs"] > 0 for r in rows)
+            # upmap: swap one PG's replica, then clear it
+            up, _ = c.mon.osdmap.pg_to_up_acting_osds((1, 0))
+            absent = next(i for i in range(4) if i not in up)
+            rc, outs, _ = await c.client.mon_command(
+                ["osd", "pg-upmap-items", "1.0",
+                 str(up[0]), str(absent)])
+            assert rc == 0
+            up2, _ = c.mon.osdmap.pg_to_up_acting_osds((1, 0))
+            assert absent in up2 and up[0] not in up2
+            rc, _, _ = await c.client.mon_command(
+                ["osd", "rm-pg-upmap-items", "1.0"])
+            assert rc == 0
+            up3, _ = c.mon.osdmap.pg_to_up_acting_osds((1, 0))
+            assert up3 == up
+            # bad pgid -> -22, not a crash
+            rc, _, _ = await c.client.mon_command(
+                ["osd", "pg-upmap-items", "junk", "0", "1"])
+            assert rc == -22
+        finally:
+            await c.stop()
+
+    run(t())
+
+
+def test_rados_namespaces_ioctx():
+    """IoCtx namespace scoping (rados_ioctx_set_namespace role): same
+    object names coexist per namespace; listings are scoped; the
+    default namespace rejects the reserved lead byte."""
+    async def t():
+        c = await make(n_osds=3)
+        try:
+            blue = c.client.ioctx(1, "blue")
+            green = c.client.ioctx(1, "green")
+            await c.client.write_full(1, "obj", b"default")
+            await blue.write_full(1, "obj", b"blue")
+            await green.write_full(1, "obj", b"green")
+            assert await c.client.read(1, "obj") == b"default"
+            assert await blue.read(1, "obj") == b"blue"
+            assert await green.read(1, "obj") == b"green"
+            assert await blue.list_objects(1) == [b"obj"]
+            assert sorted(await blue.ioctx(1).list_namespaces(1)) == [
+                "", "blue", "green"]
+            # xattrs/omap ride the same scoping
+            await blue.setxattr(1, "obj", "k", b"v")
+            assert await blue.getxattr(1, "obj", "k") == b"v"
+            import pytest as _pt
+            with _pt.raises(KeyError):
+                await green.getxattr(1, "obj", "k")
+            # delete is scoped
+            await blue.delete(1, "obj")
+            with _pt.raises(KeyError):
+                await blue.read(1, "obj")
+            assert await green.read(1, "obj") == b"green"
+            # default namespace: reserved byte refused
+            with _pt.raises(ValueError):
+                await c.client.ioctx(1).write_full(1, b"\x1ex", b"d")
+        finally:
+            await c.stop()
+
+    run(t())
+
+
+def test_rbd_pool_namespaces():
+    """rbd pool namespaces: registry create/ls/rm, per-namespace image
+    directories, and non-empty protection."""
+    from ceph_tpu.osdc.striper import FileLayout
+    from ceph_tpu.services import RBD
+
+    lo = FileLayout(stripe_unit=8192, stripe_count=1,
+                    object_size=8192)
+
+    async def t():
+        c = await make(n_osds=3)
+        try:
+            rbd = RBD(c.client, 1)
+            await rbd.namespace_create("tenant-a")
+            await rbd.namespace_create("tenant-b")
+            assert await rbd.namespace_list() == ["tenant-a",
+                                                  "tenant-b"]
+            ra = RBD(c.client, 1, namespace="tenant-a")
+            rb = RBD(c.client, 1, namespace="tenant-b")
+            await rbd.create("disk", 16 * 1024, lo)
+            await ra.create("disk", 16 * 1024, lo)  # same name, own ns
+            await rb.create("other", 16 * 1024, lo)
+            assert await rbd.list() == ["disk"]
+            assert await ra.list() == ["disk"]
+            assert await rb.list() == ["other"]
+            ia = await ra.open("disk")
+            await ia.write(0, b"tenant-a data")
+            await ia.release_lock()
+            i0 = await rbd.open("disk")
+            assert await i0.read(0, 13) == b"\0" * 13  # isolated
+            await i0.release_lock()
+            # trash is per-namespace too
+            tid = await ra.trash_move("disk")
+            assert await ra.list() == [] and await rbd.list() == ["disk"]
+            import pytest as _pt
+            with _pt.raises(RuntimeError):  # trash entry keeps it busy
+                await rbd.namespace_remove("tenant-a")
+            await ra.trash_restore(tid)
+            await ra.remove("disk")
+            await rbd.namespace_remove("tenant-a")
+            assert await rbd.namespace_list() == ["tenant-b"]
+            with _pt.raises(RuntimeError):
+                await rbd.namespace_remove("tenant-b")
+        finally:
+            await c.stop()
+
+    run(t())

@@ -376,3 +376,41 @@ def test_multiprocess_multimds_pin_and_cross_rename(tmp_path):
             await c.stop()
 
     run(t())
+
+
+def test_multiprocess_mon_command(tmp_path):
+    """The `ceph` CLI seam over real sockets: MMonCommand rides
+    NetBus to a mon PROCESS (forwarded to the paxos leader when it
+    lands on a peon) and mutates the committed map."""
+    import json
+
+    async def t():
+        c = await make(tmp_path, n_mons=3)
+        try:
+            rc, outs, outb = await c.client.mon_command(["status"])
+            assert rc == 0
+            st = json.loads(outb)
+            assert st["osdmap"]["num_up_osds"] == 3
+            assert st["monmap"]["num_mons"] == 3
+            rc, _, outb = await c.client.mon_command(["osd", "tree"])
+            assert rc == 0
+            rows = [n for n in json.loads(outb) if n["type"] == "osd"]
+            assert len(rows) == 3
+            # a mutating command commits through paxos quorum
+            rc, _, _ = await c.client.mon_command(
+                ["osd", "reweight", "2", "0.5"])
+            assert rc == 0
+            for _ in range(100):
+                if (c.client.osdmap is not None
+                        and c.client.osdmap.osds[2].weight == 0x8000):
+                    break
+                await asyncio.sleep(0.1)
+            assert c.client.osdmap.osds[2].weight == 0x8000
+            # quorum_status names a leader all ranks agree on
+            rc, _, outb = await c.client.mon_command(["quorum_status"])
+            q = json.loads(outb)
+            assert len(q["quorum"]) == 3
+        finally:
+            await c.stop()
+
+    run(t())
