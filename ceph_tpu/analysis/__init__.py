@@ -1,0 +1,53 @@
+"""tpulint: AST-based static analysis for TPU-kernel hygiene and
+distributed-correctness invariants.
+
+The hot paths of this tree — GF(2^8) erasure matmuls, batched CRC32C,
+straw2 placement — are won or lost at the code-structure level (the
+arXiv:2108.02692 lesson): a single host sync inside a jitted kernel or
+a float dtype in a GF(2^8) path silently destroys the whole point of
+the port. Nothing in the type system stops such a PR; this package
+does, statically, with nothing but the stdlib ``ast`` module.
+
+Rule families (each a plugin in the registry, mirroring the
+ErasureCodePlugin/Checksummer seam):
+
+- ``trace-safety``  — host-sync / recompile hazards inside
+  ``jax.jit``-compiled functions (rules_trace.py);
+- ``dtype``         — implicit or float dtypes where GF(2^8)/CRC
+  word-size discipline is required (rules_dtype.py);
+- ``wire-parity``   — encode/decode field-order asymmetry in the wire
+  layer (rules_wire.py);
+- ``lock-discipline`` — shared-state writes outside the owning lock
+  and blocking calls made while holding one (rules_lock.py).
+
+Grandfathered findings live in a committed baseline
+(tools/tpulint_baseline.json); anything NEW fails the tier-1 gate
+(tests/test_tpulint.py). CLI: ``python tools/tpulint.py``.
+"""
+from __future__ import annotations
+
+from .baseline import load_baseline, save_baseline, unbaselined
+from .core import (
+    Finding,
+    Rule,
+    RuleRegistry,
+    instance,
+    lint_source,
+    preload,
+    register,
+    run_paths,
+)
+
+__all__ = [
+    "Finding",
+    "Rule",
+    "RuleRegistry",
+    "instance",
+    "register",
+    "preload",
+    "run_paths",
+    "lint_source",
+    "load_baseline",
+    "save_baseline",
+    "unbaselined",
+]

@@ -1,0 +1,124 @@
+"""dtype discipline in the GF(2^8)/CRC word-size-critical layers.
+
+arXiv:1701.07731's polynomial-ring EC results hinge on strict word-size
+discipline; in this tree the same contract lives in ceph_tpu/ec (GF(2^8)
+tables are uint8, bitmatrix planes uint32), ceph_tpu/checksum (CRC
+words are uint32), and ceph_tpu/placement (straw2 is fixed-point u32/
+u64 by design — a float anywhere breaks bit-parity with the reference).
+
+Three checks, scoped to those packages:
+
+- array constructors without an explicit dtype (``np.zeros(n)`` is
+  float64; ``np.frombuffer(b)`` is float64 and raises on odd lengths
+  — both silently poison a GF path);
+- float dtypes by name (``np.float32``, ``dtype=float``, ``"float64"``,
+  ``astype(float)``) — GF(2^8) and CRC state have no float form;
+- ``+``/``-``/``*`` arithmetic inside GF-named functions, where field
+  semantics require XOR / table lookups instead.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from .core import Finding, Rule, ScopedVisitor, call_name, register
+
+_SCOPES = ("ceph_tpu/ec/", "ceph_tpu/checksum/", "ceph_tpu/placement/")
+
+_NP_MODS = ("np", "jnp", "numpy", "jax.numpy")
+#: constructor -> 0-based positional index where dtype may ride
+_NEED_DTYPE = {
+    "zeros": 1, "ones": 1, "empty": 1, "full": 2, "array": 1,
+    "arange": 3, "eye": 3, "frombuffer": 1,
+}
+_FLOAT_NAMES = frozenset((
+    "float16", "float32", "float64", "bfloat16", "float_", "double",
+    "half", "single",
+))
+_GF_MARKERS = ("gf", "galois")
+
+
+def _is_array_ctor(name: str) -> str | None:
+    mod, _, fn = name.rpartition(".")
+    return fn if mod in _NP_MODS and fn in _NEED_DTYPE else None
+
+
+def _float_dtype_name(node: ast.AST) -> str | None:
+    """`np.float32`, bare `float`, or a "float64" string literal."""
+    name = call_name(node)
+    if name:
+        mod, _, leaf = name.rpartition(".")
+        if leaf in _FLOAT_NAMES and (not mod or mod in _NP_MODS):
+            return name
+        if name == "float":
+            return name
+    if (isinstance(node, ast.Constant) and isinstance(node.value, str)
+            and node.value.lstrip("<>=") in _FLOAT_NAMES):
+        return node.value
+    return None
+
+
+def _in_gf_context(scopes: list[str], path: str) -> bool:
+    hay = [s.lower() for s in scopes] + [path.rsplit("/", 1)[-1].lower()]
+    return any(m in h for m in _GF_MARKERS for h in hay)
+
+
+@register
+class DtypeRule(Rule):
+    id = "dtype"
+
+    def applies(self, path: str) -> bool:
+        return any(path.startswith(s) or f"/{s}" in f"/{path}"
+                   for s in _SCOPES)
+
+    def check(self, tree: ast.Module, path: str,
+              source: str) -> Iterator[Finding]:
+        rule_id = self.id
+        findings: list[Finding] = []
+
+        class V(ScopedVisitor):
+            def visit_Call(self, node: ast.Call) -> None:
+                name = call_name(node.func)
+                ctor = _is_array_ctor(name)
+                kwargs = {k.arg for k in node.keywords}
+                if ctor is not None and "dtype" not in kwargs:
+                    # np.zeros(n, np.uint8): dtype passed positionally
+                    if len(node.args) <= _NEED_DTYPE[ctor]:
+                        findings.append(Finding(
+                            rule_id, path, node.lineno, self.symbol,
+                            f"`{name}` without an explicit dtype "
+                            "defaults to float64 in a GF/CRC path"))
+                for kw in node.keywords:
+                    if kw.arg == "dtype":
+                        bad = _float_dtype_name(kw.value)
+                        if bad is not None:
+                            findings.append(Finding(
+                                rule_id, path, kw.value.lineno,
+                                self.symbol,
+                                f"float dtype `{bad}` where GF(2^8)/"
+                                "CRC integer words are required"))
+                if (isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "astype" and node.args):
+                    bad = _float_dtype_name(node.args[0])
+                    if bad is not None:
+                        findings.append(Finding(
+                            rule_id, path, node.lineno, self.symbol,
+                            f"`.astype({bad})` in a GF(2^8)/CRC path"))
+                self.generic_visit(node)
+
+            def visit_BinOp(self, node: ast.BinOp) -> None:
+                if (_in_gf_context(self.scope, path)
+                        and isinstance(node.op,
+                                       (ast.Add, ast.Sub, ast.Mult))
+                        and not isinstance(node.left, ast.Constant)
+                        and not isinstance(node.right, ast.Constant)):
+                    op = {ast.Add: "+", ast.Sub: "-",
+                          ast.Mult: "*"}[type(node.op)]
+                    findings.append(Finding(
+                        rule_id, path, node.lineno, self.symbol,
+                        f"integer `{op}` in a GF(2^8) context — field "
+                        "semantics need XOR / table lookups"))
+                self.generic_visit(node)
+
+        V().visit(tree)
+        return iter(findings)

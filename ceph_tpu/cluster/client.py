@@ -29,6 +29,15 @@ _WRITE_VERBS = frozenset((
     "omap_setheader", "omap_clear", "call",
 ))
 
+#: write verbs still admitted to a quota-FULL pool (the librados
+#: LIBRADOS_OPERATION_FULL_TRY stance): space-reclaiming ops must pass
+#: or usage can never drop and the FULL flag never self-clears — the
+#: only exit would be raising the quota. truncate is NOT here: it can
+#: extend an object, which is exactly the growth the gate must stop.
+_FULL_OK_VERBS = frozenset((
+    "delete", "rmxattr", "omap_rmkeys", "omap_clear",
+))
+
 
 class RadosError(IOError):
     """Op-vector failure with its errno-style code attached (librados
@@ -325,9 +334,11 @@ class RadosClient:
         if self.osdmap is None or pool_id not in self.osdmap.pools:
             await self._wait_pool(pool_id)
         pool = self.osdmap.pools[pool_id]
-        if pool.full and any(o[0] in _WRITE_VERBS for o in ops):
+        if pool.full and any(o[0] in _WRITE_VERBS
+                             and o[0] not in _FULL_OK_VERBS
+                             for o in ops):
             # pool quota reached (FLAG_FULL_QUOTA): fail writes with
-            # EDQUOT like the reference's objecter_full_try stance
+            # EDQUOT; reclaiming verbs ride through (FULL_TRY)
             raise RadosError(M.EDQUOT,
                              f"pool '{pool.name}' quota reached")
         oid = name.encode() if isinstance(name, str) else bytes(name)

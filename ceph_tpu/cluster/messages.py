@@ -48,6 +48,7 @@ LAZY_ENTRIES = (_enc_lazy_entries, _dec_field_bytes)
 
 # op result codes (negated errno style, like the reference)
 OK = 0
+EPERM = -1
 ENOENT = -2
 EIO = -5
 EAGAIN = -11

@@ -523,12 +523,35 @@ async def _cmd_pool_create(mon, cmd):
                {"pool_id": pool_id})
 
 
-@_command("osd pool rm name=pool,type=str", "remove a pool (by name)")
+@_command(
+    "osd pool rm name=pool,type=str name=pool2,type=str,req=0 "
+    "name=sure,type=str,req=0",
+    "remove a pool (name twice + --yes-i-really-really-mean-it; "
+    "requires mon_allow_pool_delete)")
 async def _cmd_pool_rm(mon, cmd):
+    """Pool deletion is irreversible — OSDs purge every object and
+    collection on the next epoch — so it is triple-interlocked like
+    the reference (OSDMonitor::prepare_command pool delete guards):
+    the mon_allow_pool_delete config flag, the pool name repeated,
+    and the --yes-i-really-really-mean-it literal."""
     pool = next((p for p in mon.osdmap.pools.values()
                  if p.name == cmd["pool"]), None)
     if pool is None:
         return (M.ENOENT, f"pool '{cmd['pool']}' not found", b"")
+    allow = str(mon.config_db.get(("mon", "mon_allow_pool_delete"),
+                                  "false")).lower()
+    if allow not in ("true", "1", "yes"):
+        return (M.EPERM,
+                "pool deletion is disabled; you must first set the "
+                "mon_allow_pool_delete config option to true before "
+                "you can destroy a pool", b"")
+    if cmd.get("pool2") != cmd["pool"] or \
+            cmd.get("sure") != "--yes-i-really-really-mean-it":
+        return (M.EPERM,
+                f"WARNING: this will PERMANENTLY DESTROY all data in "
+                f"pool '{cmd['pool']}'. If you are ABSOLUTELY CERTAIN "
+                f"that is what you want, pass the pool name twice, "
+                f"followed by --yes-i-really-really-mean-it.", b"")
     inc = mon._new_inc()
     inc.removed_pools.append(pool.id)
     await mon.commit(inc)

@@ -751,6 +751,7 @@ class MDSLite:
             except fslib.FSError:
                 async with self._lock:
                     await self._apply_rename(path, dst)
+                    self._quota_recount_move(path, dst)
                     await self._expire(seq)
                     self._rename_open_paths(path, dst)
                 return {}
@@ -761,6 +762,10 @@ class MDSLite:
             await self.client.omap_rm(
                 self.meta_pool, fslib._dir_oid(xr.sp),
                 [xr.sn.encode()])
+            # the destination realm lives on the peer rank (its own
+            # cache); invalidate our source-side counts (pop, not
+            # decrement: the moved entry may be a whole subtree)
+            self._quota_recount_move(path, dst)
             await self._expire(seq)
             self._rename_open_paths(path, dst)
         return {}
@@ -816,6 +821,7 @@ class MDSLite:
             seq = await self._journal(verb, args)
             await self._apply_rename(path, dst,
                                      crash=self._crash_mid_rename)
+            self._quota_recount_move(path, dst)
             await self._expire(seq)
             self._rename_open_paths(path, dst)
             return {}
@@ -904,6 +910,34 @@ class MDSLite:
         # account the entry this check just admitted
         self._realm_count_cache[rpath] = (now + 2.0, count + 1)
 
+    def _quota_uncount(self, path: str) -> None:
+        """Inverse of the self-advance above: unlink/rmdir must
+        decrement every cached realm count covering ``path``, or a
+        sustained create burst keeps the inflated count alive (each
+        accepted create re-extends the TTL) and deletes never free
+        quota — spurious EDQUOT long after space was reclaimed.
+        Adjust-by-1 is exact here: unlink takes one file, rmdir one
+        EMPTY directory (non-empty raises NotEmpty); renames go
+        through _quota_recount_move instead."""
+        p = _norm(path)
+        for rpath, (exp, count) in list(
+                self._realm_count_cache.items()):
+            if _under(p, rpath):
+                self._realm_count_cache[rpath] = (exp,
+                                                  max(0, count - 1))
+
+    def _quota_recount_move(self, src: str, dst: str) -> None:
+        """Rename moved an entry between realms: INVALIDATE every
+        cached count covering exactly one side. Adjusting by 1 would
+        be wrong for a non-empty directory (the cache holds recursive
+        rf+rd subtree counts); a pop re-syncs from subtree_stats on
+        the next create, correct for any subtree size. Realms covering
+        both sides are unchanged and keep their entry."""
+        s, d = _norm(src), _norm(dst)
+        for rpath in list(self._realm_count_cache):
+            if _under(s, rpath) != _under(d, rpath):
+                self._realm_count_cache.pop(rpath, None)
+
     async def _apply_mksnap(self, dir_ino: int, name: str,
                             sid: int) -> None:
         """Freeze the subtree's dirfrags under snapshot oids (BFS; the
@@ -983,9 +1017,11 @@ class MDSLite:
                 # (CephFS forbids this for the same reason)
                 raise fslib.NotEmpty(f"{path} has snapshots")
             await self.fs.rmdir(path)
+            self._quota_uncount(path)
             return {}
         if verb == "unlink":
             await self.fs.unlink(path)
+            self._quota_uncount(path)
             return {}
         if verb == "truncate":
             size = denc.dec_u64(args["size"], 0)[0]
@@ -995,7 +1031,9 @@ class MDSLite:
             ino = await self.fs.create(path)
             return {"ino": denc.enc_u64(ino)}
         if verb == "rename":
-            await self._apply_rename(path, args["dst"].decode())
+            dst = args["dst"].decode()
+            await self._apply_rename(path, dst)
+            self._quota_recount_move(path, dst)
             return {}
         if verb == "mksnap":
             sid = denc.dec_u64(args["sid"], 0)[0]

@@ -1389,22 +1389,30 @@ class S3Frontend(HttpFrontend):
         #: browsers send Origin on EVERY request; without this cache
         #: each cross-origin GET would pay two extra RADOS reads
         self._cors_cache: dict[str, tuple[float, list]] = {}
+        #: bucket -> write generation. A preflight may suspend in the
+        #: store read across a concurrent cors PUT/DELETE in EITHER
+        #: order; it may only cache what it read if no write completed
+        #: since it started (invalidate-then-insert races both ways —
+        #: only the generation check closes both interleavings).
+        self._cors_gen: dict[str, int] = {}
 
     async def _cors_rules(self, bucket: str) -> list[dict]:
         hit = self._cors_cache.get(bucket)
         now = time.monotonic()
         if hit is not None and now < hit[0]:
             return hit[1]
+        gen = self._cors_gen.get(bucket, 0)
         try:
             rules = await self.rgw.get_bucket_cors(bucket)
         except RGWError:
             rules = []
-        if len(self._cors_cache) >= 1024:
-            # bounded: bucket names here are attacker-controlled via
-            # the unauthenticated OPTIONS path — an unbounded dict
-            # would be a memory-exhaustion vector
-            self._cors_cache.pop(next(iter(self._cors_cache)))
-        self._cors_cache[bucket] = (now + 5.0, rules)
+        if self._cors_gen.get(bucket, 0) == gen:
+            if len(self._cors_cache) >= 1024:
+                # bounded: bucket names here are attacker-controlled
+                # via the unauthenticated OPTIONS path — an unbounded
+                # dict would be a memory-exhaustion vector
+                self._cors_cache.pop(next(iter(self._cors_cache)))
+            self._cors_cache[bucket] = (now + 5.0, rules)
         return rules
 
     def _authenticate(self, method: str, target: str, headers: dict,
@@ -1667,9 +1675,26 @@ class S3Frontend(HttpFrontend):
                     await self._authz_bucket(
                         bucket, principal,
                         "READ" if method == "GET" else "FULL_CONTROL")
-                    self._cors_cache.pop(bucket, None)
-                    return await self._bucket_cors(
+                    resp = await self._bucket_cors(
                         method, bucket, body)
+                    if method in ("PUT", "DELETE"):
+                        # invalidate AFTER the store write (popping
+                        # first lets a racing preflight re-cache the
+                        # OLD rules during the write), and bump the
+                        # generation so a preflight that READ before
+                        # this write refuses to cache its stale copy
+                        if len(self._cors_gen) >= 8192 \
+                                and bucket not in self._cors_gen:
+                            # bounded like _cors_cache; a reader
+                            # racing an evicted entry merely declines
+                            # to cache (gen mismatch), never serves
+                            # stale
+                            self._cors_gen.pop(
+                                next(iter(self._cors_gen)))
+                        self._cors_gen[bucket] = \
+                            self._cors_gen.get(bucket, 0) + 1
+                        self._cors_cache.pop(bucket, None)
+                    return resp
                 if "versions" in query:
                     await self._authz_bucket(bucket, principal,
                                              "READ")
@@ -1686,6 +1711,11 @@ class S3Frontend(HttpFrontend):
                     await self._authz_bucket(bucket, principal,
                                              "FULL_CONTROL")
                     await self.rgw.delete_bucket(bucket)
+                    # drop the bucket's CORS state with it, or a
+                    # create/put-cors/delete loop over fresh names
+                    # leaks a generation entry per iteration
+                    self._cors_cache.pop(bucket, None)
+                    self._cors_gen.pop(bucket, None)
                     return 204, {}, b""
                 if method == "GET":
                     await self._authz_bucket(bucket, principal,

@@ -243,7 +243,81 @@ def test_pool_quota_blocks_writes_and_clears():
     run(t())
 
 
+def test_full_pool_allows_delete_and_self_clears():
+    """FULL_TRY stance: a quota-FULL pool must accept deletes so space
+    can be reclaimed and the FULL flag can clear WITHOUT raising the
+    quota — otherwise the pool is wedged forever."""
+    async def t():
+        c = await make()
+        try:
+            rc, _, _ = await c.client.mon_command(
+                ["osd", "pool", "set", "p", "quota_max_objects", "3"])
+            assert rc == 0
+            # exactly 3 writes: the flag trips at objs >= quota, so a
+            # 4th write would race the stats digest and flake
+            for i in range(3):
+                await c.client.write_full(1, f"q{i}", b"d" * 64)
+            for _ in range(80):
+                if c.client.osdmap.pools[1].full:
+                    break
+                await asyncio.sleep(0.25)
+            assert c.client.osdmap.pools[1].full
+            with pytest.raises(RadosError):
+                await c.client.write_full(1, "overflow", b"x")
+            # deletes ride through the FULL flag
+            await c.client.delete(1, "q0")
+            await c.client.delete(1, "q1")
+            # with usage back under quota the mon clears FULL and
+            # writes resume — the flag self-clears via reclamation
+            for _ in range(80):
+                if not c.client.osdmap.pools[1].full:
+                    break
+                await asyncio.sleep(0.25)
+            assert not c.client.osdmap.pools[1].full
+            await c.client.write_full(1, "after", b"x")
+        finally:
+            await c.stop()
+
+    run(t())
+
+
 # ----------------------------------------------------------- pool rm
+
+
+def test_pool_rm_requires_triple_interlock():
+    """Pool deletion is gated like the reference: the
+    mon_allow_pool_delete config flag, the name twice, and the
+    --yes-i-really-really-mean-it literal — each missing piece is
+    EPERM and the pool survives."""
+    async def t():
+        c = await make()
+        try:
+            # config flag off: refused regardless of confirmations
+            rc, outs, _ = await c.client.mon_command(
+                ["osd", "pool", "rm", "p", "p",
+                 "--yes-i-really-really-mean-it"])
+            assert rc == M.EPERM
+            assert "mon_allow_pool_delete" in outs
+            rc, _, _ = await c.client.mon_command(
+                ["config", "set", "mon", "mon_allow_pool_delete",
+                 "true"])
+            assert rc == 0
+            # flag on, but no / wrong confirmation: still refused
+            rc, outs, _ = await c.client.mon_command(
+                ["osd", "pool", "rm", "p"])
+            assert rc == M.EPERM
+            rc, _, _ = await c.client.mon_command(
+                ["osd", "pool", "rm", "p", "q",
+                 "--yes-i-really-really-mean-it"])
+            assert rc == M.EPERM
+            rc, _, _ = await c.client.mon_command(
+                ["osd", "pool", "rm", "p", "p"])
+            assert rc == M.EPERM
+            assert 1 in c.mon.osdmap.pools
+        finally:
+            await c.stop()
+
+    run(t())
 
 
 def test_pool_rm_drops_pgs_and_objects():
@@ -253,7 +327,12 @@ def test_pool_rm_drops_pgs_and_objects():
             for i in range(5):
                 await c.client.write_full(1, f"del{i}", b"y" * 128)
             rc, _, _ = await c.client.mon_command(
-                ["osd", "pool", "rm", "p"])
+                ["config", "set", "mon", "mon_allow_pool_delete",
+                 "true"])
+            assert rc == 0
+            rc, _, _ = await c.client.mon_command(
+                ["osd", "pool", "rm", "p", "p",
+                 "--yes-i-really-really-mean-it"])
             assert rc == 0
             assert 1 not in c.mon.osdmap.pools
             # OSDs drop the pool's PGs + collections on the new epoch
@@ -265,7 +344,8 @@ def test_pool_rm_drops_pgs_and_objects():
                 await asyncio.sleep(0.1)
             assert not left
             rc, _, _ = await c.client.mon_command(
-                ["osd", "pool", "rm", "p"])
+                ["osd", "pool", "rm", "p", "p",
+                 "--yes-i-really-really-mean-it"])
             assert rc == M.ENOENT
         finally:
             await c.stop()
@@ -339,8 +419,12 @@ def test_rados_namespaces_ioctx():
             await blue.setxattr(1, "obj", "k", b"v")
             assert await blue.getxattr(1, "obj", "k") == b"v"
             import pytest as _pt
-            with _pt.raises(KeyError):
+            # missing xattr on an EXISTING object is ENODATA, not
+            # KeyError (KeyError maps only from ENOENT; other callers
+            # rely on the ENODATA distinction — see absent_attr)
+            with _pt.raises(RadosError) as ei:
                 await green.getxattr(1, "obj", "k")
+            assert ei.value.code == RadosError.ENODATA
             # delete is scoped
             await blue.delete(1, "obj")
             with _pt.raises(KeyError):

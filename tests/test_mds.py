@@ -433,6 +433,48 @@ def test_foreign_truncate_invalidates_cached_reader():
     run(t())
 
 
+def test_quota_count_cache_deflates_on_unlink():
+    """Regression: the realm count cache self-advances on every
+    accepted create (and each accept re-extends its TTL), but deletes
+    must deflate it too — otherwise a sustained create/delete churn
+    under a max_files quota returns EDQUOT while the realm is actually
+    under the limit."""
+    import ceph_tpu.services.fs as fslib
+
+    async def t():
+        c, mds, a, _b = await make()
+        await a.mkdir("/q")
+        await a.set_quota("/q", max_files=3)
+        await a.create("/q/f1")
+        await a.create("/q/f2")
+        await a.create("/q/f3")
+        with pytest.raises(fslib.QuotaExceeded):
+            await a.create("/q/f4")
+        # churn: delete + create repeatedly WITHIN the cache TTL; the
+        # cached count must deflate on each unlink or the self-advance
+        # keeps it pinned at the limit and every create EDQUOTs
+        for i in range(5):
+            await a.unlink("/q/f1")
+            await a.create("/q/f1")
+        # rmdir deflates too: swap a dir out for a file at the limit
+        await a.unlink("/q/f1")
+        await a.mkdir("/q/d1")
+        with pytest.raises(fslib.QuotaExceeded):
+            await a.create("/q/f5")
+        await a.rmdir("/q/d1")
+        await a.create("/q/f5")
+        # rename OUT of the realm deflates it the same way (and the
+        # realm-free destination never blocks)
+        await a.mkdir("/out")
+        await a.rename("/q/f5", "/out/f5")
+        await a.create("/q/f6")
+        with pytest.raises(fslib.QuotaExceeded):
+            await a.create("/q/f7")
+        await c.stop()
+
+    run(t())
+
+
 def test_quotas_files_and_bytes():
     """ceph.quota.max_files (MDS-enforced on create/mkdir) and
     max_bytes (client-enforced on growing writes), realm nesting,

@@ -785,3 +785,83 @@ def test_tagging_and_cors_http_routes():
             await c.stop()
 
     run(t())
+
+
+def test_cors_cache_invalidated_after_store_write():
+    """Regression (race): a preflight that re-reads the OLD rules
+    while a cors PUT is mid-write must not leave them cached past the
+    write — invalidation happens AFTER the store write completes, so
+    the racing entry is popped and the next preflight re-reads."""
+    async def t():
+        c, rgw = await make()
+        await rgw.create_bucket("web")
+        fe = S3Frontend(rgw)
+        host, port = await fe.start()
+        try:
+            cors = (b"<CORSConfiguration><CORSRule>"
+                    b"<AllowedOrigin>https://a.example</AllowedOrigin>"
+                    b"<AllowedMethod>GET</AllowedMethod>"
+                    b"</CORSRule></CORSConfiguration>")
+            st, _, _ = await http(host, port, "PUT", "/web?cors", cors)
+            assert st == 200
+            # the racing preflight: re-caches the CURRENT (soon stale)
+            # rules exactly between the route's cache handling and the
+            # store write finishing
+            real_put = rgw.put_bucket_cors
+            stale = await rgw.get_bucket_cors("web")
+
+            async def racing_put(bucket, rules):
+                fe._cors_cache[bucket] = (1e18, stale)
+                await real_put(bucket, rules)
+
+            rgw.put_bucket_cors = racing_put
+            cors2 = cors.replace(b"https://a.example",
+                                 b"https://b.example")
+            st, _, _ = await http(host, port, "PUT", "/web?cors",
+                                  cors2)
+            assert st == 200
+            rgw.put_bucket_cors = real_put
+            # the post-write pop evicted the racing entry: preflight
+            # serves the NEW origin, not the stale cache
+            st, rh, _ = await http(
+                host, port, "OPTIONS", "/web/o",
+                headers={"origin": "https://b.example",
+                         "access-control-request-method": "GET"})
+            assert st == 200
+            assert rh.get("access-control-allow-origin") \
+                == "https://b.example"
+            # mirrored interleaving: a preflight READS the old rules,
+            # suspends, the PUT completes (pop + generation bump), the
+            # preflight resumes — it must NOT cache its stale copy
+            real_get = rgw.get_bucket_cors
+            hold = asyncio.Event()
+
+            async def slow_get(bucket):
+                rules = await real_get(bucket)
+                await hold.wait()
+                return rules
+
+            rgw.get_bucket_cors = slow_get
+            fe._cors_cache.pop("web", None)
+            reader = asyncio.create_task(fe._cors_rules("web"))
+            await asyncio.sleep(0.05)  # reader holds the OLD rules
+            rgw.get_bucket_cors = real_get
+            cors3 = cors.replace(b"https://a.example",
+                                 b"https://c.example")
+            st, _, _ = await http(host, port, "PUT", "/web?cors",
+                                  cors3)
+            assert st == 200
+            hold.set()
+            await reader  # returns stale rules to ITS caller only
+            st, rh, _ = await http(
+                host, port, "OPTIONS", "/web/o",
+                headers={"origin": "https://c.example",
+                         "access-control-request-method": "GET"})
+            assert st == 200
+            assert rh.get("access-control-allow-origin") \
+                == "https://c.example"
+        finally:
+            await fe.stop()
+            await c.stop()
+
+    run(t())

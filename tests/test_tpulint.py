@@ -1,0 +1,489 @@
+"""tpulint: per-rule fixtures + the whole-repo tier-1 gate.
+
+Each rule family gets positive fixtures (the hazard MUST fire) and
+negative fixtures (the idiomatic form MUST stay clean — false-positive
+regression guards). The gate at the bottom runs the full analyzer over
+ceph_tpu/ and tools/ against the committed baseline: any NEW finding
+fails tier-1, which is the whole point of the pass.
+"""
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from ceph_tpu import analysis
+
+REPO = Path(__file__).resolve().parents[1]
+BASELINE = REPO / "tools" / "tpulint_baseline.json"
+
+
+def lint(src: str, path: str, only=None):
+    return analysis.lint_source(textwrap.dedent(src), path, only)
+
+
+def msgs(findings):
+    return [f.message for f in findings]
+
+
+# ---------------------------------------------------------- trace-safety
+
+
+def test_trace_decorated_jit_host_sync_fires():
+    out = lint(
+        """
+        import jax
+
+        @jax.jit
+        def kernel(x):
+            n = x.sum().item()
+            print(n)
+            return x * n
+        """,
+        "ceph_tpu/ops/fixture.py", only=["trace-safety"])
+    assert any(".item()" in m for m in msgs(out))
+    assert any("print" in m for m in msgs(out))
+
+
+def test_trace_assigned_jit_and_partial_binding():
+    # jax.jit(partial(f, host_const)): the bound arg is static, so
+    # int() on it is fine; int() on the traced arg fires.
+    out = lint(
+        """
+        import functools, jax
+
+        def kernel(matrix, chunks):
+            c = int(matrix[0, 0])   # static: partial-bound
+            k = int(chunks[0])      # traced: must fire
+            return chunks * c + k
+
+        _jit = jax.jit(functools.partial(kernel, M))
+        """,
+        "ceph_tpu/ops/fixture.py", only=["trace-safety"])
+    assert len(out) == 1
+    assert "`int()` on a traced value" in out[0].message
+
+
+def test_trace_static_argnames_suppresses():
+    out = lint(
+        """
+        import jax
+
+        def run(xs, static):
+            return xs * int(static)
+
+        run_jit = jax.jit(run, static_argnames=("static",))
+        """,
+        "ceph_tpu/placement/fixture.py", only=["trace-safety"])
+    assert out == []
+
+
+def test_trace_self_mutation_and_np_asarray_fire():
+    out = lint(
+        """
+        import jax
+        import numpy as np
+
+        class Engine:
+            @jax.jit
+            def step(self, x):
+                self.count = self.count + 1
+                return np.asarray(x)
+        """,
+        "ceph_tpu/ops/fixture.py", only=["trace-safety"])
+    assert any("mutation of `self.count`" in m for m in msgs(out))
+    assert any("np.asarray" in m for m in msgs(out))
+
+
+def test_trace_unhashable_static_argnums():
+    out = lint(
+        """
+        import jax
+
+        def f(x, n):
+            return x
+
+        g = jax.jit(f, static_argnums=[1])
+        """,
+        "ceph_tpu/ops/fixture.py", only=["trace-safety"])
+    assert any("unhashable" in m for m in msgs(out))
+
+
+def test_trace_shape_metadata_access_is_clean():
+    # int(x.shape[0]) is static metadata, not a concretization
+    out = lint(
+        """
+        import jax
+
+        @jax.jit
+        def f(x):
+            n = int(x.shape[0]) * int(x.ndim)
+            return x.reshape(n)
+        """,
+        "ceph_tpu/ops/fixture.py", only=["trace-safety"])
+    assert out == []
+
+
+def test_trace_clean_kernel_is_clean():
+    # the idiom of ops/crc32c.py: shape access, astype, while loop
+    out = lint(
+        """
+        import jax
+        import jax.numpy as jnp
+
+        def _crc0(words):
+            w = words.shape[-1]
+            c = words.astype(jnp.uint32)
+            return c[..., 0]
+
+        _jit = jax.jit(_crc0)
+        """,
+        "ceph_tpu/ops/fixture.py", only=["trace-safety"])
+    assert out == []
+
+
+def test_trace_real_kernels_are_clean():
+    for rel in ("ceph_tpu/ops/crc32c.py", "ceph_tpu/ops/rs.py",
+                "ceph_tpu/ops/crush.py"):
+        src = (REPO / rel).read_text(encoding="utf-8")
+        assert lint(src, rel, only=["trace-safety"]) == []
+
+
+# ----------------------------------------------------------------- dtype
+
+
+def test_dtype_missing_dtype_fires_only_in_scope():
+    src = """
+        import numpy as np
+
+        def make():
+            return np.zeros(16)
+        """
+    assert msgs(lint(src, "ceph_tpu/ec/fixture.py", only=["dtype"]))
+    assert msgs(lint(src, "ceph_tpu/checksum/fixture.py",
+                     only=["dtype"]))
+    # out of scope: the RGW frontend may allocate floats freely
+    assert lint(src, "ceph_tpu/services/fixture.py",
+                only=["dtype"]) == []
+
+
+def test_dtype_positional_and_kw_dtype_are_clean():
+    out = lint(
+        """
+        import numpy as np
+        import jax.numpy as jnp
+
+        def make():
+            a = np.zeros(16, np.uint8)
+            b = jnp.zeros((), jnp.uint32)
+            c = np.frombuffer(b"xy", dtype=np.uint8)
+            return a, b, c
+        """,
+        "ceph_tpu/ec/fixture.py", only=["dtype"])
+    assert out == []
+
+
+def test_dtype_float_dtype_fires():
+    out = lint(
+        """
+        import numpy as np
+
+        def make(x):
+            a = np.zeros(4, dtype=np.float32)
+            b = x.astype(float)
+            return a, b
+        """,
+        "ceph_tpu/placement/fixture.py", only=["dtype"])
+    assert any("float dtype" in m for m in msgs(out))
+    assert any("astype" in m for m in msgs(out))
+
+
+def test_dtype_gf_arithmetic_fires():
+    out = lint(
+        """
+        def gf_mul_table(a, b):
+            return a * b
+        """,
+        "ceph_tpu/ec/fixture.py", only=["dtype"])
+    assert any("XOR / table lookups" in m for m in msgs(out))
+    # same code outside a GF-named context is arithmetic, not a field op
+    out2 = lint(
+        """
+        def scale(a, b):
+            return a * b
+        """,
+        "ceph_tpu/ec/fixture.py", only=["dtype"])
+    assert out2 == []
+
+
+# ----------------------------------------------------------- wire-parity
+
+
+def test_wire_parity_symmetric_pair_is_clean():
+    out = lint(
+        """
+        from ..utils import denc
+
+        def encode_thing(t):
+            return denc.enc_u32(t.a) + denc.enc_str(t.b)
+
+        def decode_thing(buf, off=0):
+            a, off = denc.dec_u32(buf, off)
+            b, off = denc.dec_str(buf, off)
+            return (a, b), off
+        """,
+        "ceph_tpu/placement/encoding.py", only=["wire-parity"])
+    assert out == []
+
+
+def test_wire_parity_missing_field_fires():
+    out = lint(
+        """
+        from ..utils import denc
+
+        def encode_thing(t):
+            return (denc.enc_u32(t.a) + denc.enc_str(t.b)
+                    + denc.enc_u64(t.c))
+
+        def decode_thing(buf, off=0):
+            a, off = denc.dec_u32(buf, off)
+            b, off = denc.dec_str(buf, off)
+            return (a, b), off
+        """,
+        "ceph_tpu/placement/encoding.py", only=["wire-parity"])
+    assert len(out) == 1
+    assert "encoder-only kinds: u64x1" in out[0].message
+
+
+def test_wire_parity_struct_arity_mismatch_fires():
+    out = lint(
+        """
+        import struct
+
+        _HDR = struct.Struct("<IHHI")
+
+        def encode_frame(f):
+            return _HDR.pack(1, f.type, f.flags, len(f.payload))
+
+        def decode_frame(buf):
+            magic, ftype, flags = _HDR.unpack_from(buf, 0)
+            return ftype, flags
+        """,
+        "ceph_tpu/msg/frames.py", only=["wire-parity"])
+    assert any("wire skew" in m for m in msgs(out))
+
+
+def test_wire_parity_unrelated_struct_formats_do_not_collide():
+    # two independent module-level struct codecs with different
+    # formats must not be compared against each other
+    out = lint(
+        """
+        import struct
+
+        def enc_a(x):
+            return struct.pack("<I", x)
+
+        def dec_a(buf):
+            (x,) = struct.unpack("<I", buf)
+            return x
+
+        def dec_b(buf):
+            a, b = struct.unpack("<HH", buf)
+            return a, b
+        """,
+        "ceph_tpu/msg/frames.py", only=["wire-parity"])
+    assert out == []
+
+
+def test_wire_parity_real_wire_layer_is_clean():
+    for rel in ("ceph_tpu/placement/encoding.py",
+                "ceph_tpu/msg/frames.py", "ceph_tpu/msg/messages.py"):
+        src = (REPO / rel).read_text(encoding="utf-8")
+        assert lint(src, rel, only=["wire-parity"]) == []
+
+
+# ------------------------------------------------------- lock-discipline
+
+
+def test_lock_unguarded_shared_write_fires():
+    out = lint(
+        """
+        import asyncio
+
+        class Daemon:
+            def __init__(self):
+                self._lock = asyncio.Lock()
+                self.epoch = 0
+
+            async def commit(self, e):
+                async with self._lock:
+                    self.epoch = e
+
+            async def sneaky(self, e):
+                self.epoch = e
+        """,
+        "ceph_tpu/cluster/fixture.py", only=["lock-discipline"])
+    assert len(out) == 1
+    assert out[0].symbol == "Daemon.sneaky"
+    assert "outside the lock" in out[0].message
+
+
+def test_lock_init_writes_are_exempt():
+    out = lint(
+        """
+        import asyncio
+
+        class Daemon:
+            def __init__(self):
+                self._lock = asyncio.Lock()
+                self.epoch = 0
+
+            async def commit(self, e):
+                async with self._lock:
+                    self.epoch = e
+        """,
+        "ceph_tpu/cluster/fixture.py", only=["lock-discipline"])
+    assert out == []
+
+
+def test_lock_blocking_call_under_lock_fires():
+    out = lint(
+        """
+        import asyncio, time
+
+        class Daemon:
+            def __init__(self):
+                self._lock = asyncio.Lock()
+                self.n = 0
+
+            async def tick(self):
+                async with self._lock:
+                    time.sleep(1)
+                    self.n += 1
+        """,
+        "ceph_tpu/cluster/fixture.py", only=["lock-discipline"])
+    assert any("time.sleep" in m for m in msgs(out))
+
+
+def test_lock_mu_hint_is_suffix_only():
+    # `xattr_muts` is a data dict, not a lock; treating it as one
+    # would EXEMPT unlocked writes to it from the shared-state check
+    out = lint(
+        """
+        import asyncio
+
+        class PG:
+            def __init__(self):
+                self.lock = asyncio.Lock()
+                self.xattr_muts = {}
+
+            async def record(self, k, v):
+                async with self.lock:
+                    self.xattr_muts = {k: v}
+
+            async def sneaky(self, k, v):
+                self.xattr_muts = {k: v}
+        """,
+        "ceph_tpu/cluster/fixture.py", only=["lock-discipline"])
+    assert len(out) == 1 and out[0].symbol == "PG.sneaky"
+
+
+def test_lock_out_of_scope_dir_is_ignored():
+    out = lint(
+        """
+        import asyncio, time
+
+        class Frontend:
+            def __init__(self):
+                self._lock = asyncio.Lock()
+                self.n = 0
+
+            async def tick(self):
+                self.n += 1
+        """,
+        "ceph_tpu/services/fixture.py", only=["lock-discipline"])
+    assert out == []
+
+
+# ------------------------------------------------------------- registry
+
+
+def test_registry_rejects_duplicates_and_lists_rules():
+    analysis.preload()
+    reg = analysis.instance()
+    assert set(reg.names()) >= {
+        "trace-safety", "dtype", "wire-parity", "lock-discipline"}
+    with pytest.raises(KeyError):
+        reg.add("dtype", analysis.Rule)
+    with pytest.raises(KeyError):
+        reg.get("no-such-rule")
+
+
+def test_unknown_rule_id_raises():
+    with pytest.raises(KeyError):
+        analysis.lint_source("x = 1", "ceph_tpu/ec/f.py",
+                             only=["bogus"])
+
+
+# ------------------------------------------------------------- baseline
+
+
+def test_baseline_roundtrip_and_ratchet(tmp_path):
+    f1 = analysis.Finding("dtype", "a.py", 3, "f", "m1")
+    f2 = analysis.Finding("dtype", "a.py", 9, "f", "m1")  # same key
+    f3 = analysis.Finding("dtype", "a.py", 5, "g", "m2")
+    p = tmp_path / "b.json"
+    analysis.save_baseline(p, [f1, f2])
+    base = analysis.load_baseline(p)
+    # both grandfathered occurrences pass; a third same-key finding
+    # and any new key fail
+    assert analysis.unbaselined([f1, f2], base) == []
+    assert analysis.unbaselined([f1, f2, f2, f3], base) == [f2, f3]
+    # missing baseline file == empty baseline
+    assert analysis.load_baseline(tmp_path / "nope.json") == {}
+
+
+def test_update_baseline_ignores_filters(tmp_path):
+    """A filtered run (`--rules dtype ceph_tpu/ec --update-baseline`)
+    must still write the FULL baseline — honoring the filters would
+    silently erase every other grandfathered entry."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "tpulint_cli", REPO / "tools" / "tpulint.py")
+    cli = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(cli)
+    out = tmp_path / "b.json"
+    rc = cli.main(["--rules", "dtype", "ceph_tpu/ec",
+                   "--baseline", str(out), "--update-baseline"])
+    assert rc == 0
+    written = analysis.load_baseline(out)
+    committed = analysis.load_baseline(BASELINE)
+    assert written == committed
+
+
+# ------------------------------------------------------------ repo gate
+
+
+def test_repo_gate_no_new_findings():
+    """Tier-1 gate: `python tools/tpulint.py ceph_tpu tools` must be
+    clean at HEAD modulo the committed baseline."""
+    findings = analysis.run_paths(["ceph_tpu", "tools"], REPO)
+    new = analysis.unbaselined(findings,
+                               analysis.load_baseline(BASELINE))
+    assert new == [], (
+        "new tpulint findings (fix them or deliberately run "
+        "`python tools/tpulint.py --update-baseline`):\n"
+        + "\n".join(f.render() for f in new))
+
+
+def test_repo_gate_baseline_not_stale():
+    """The baseline may not carry entries for findings that no longer
+    exist — shrink it when you fix one (ratchet, not blanket)."""
+    findings = analysis.run_paths(["ceph_tpu", "tools"], REPO)
+    base = analysis.load_baseline(BASELINE)
+    live = {f.key for f in findings}
+    stale = sorted(k for k in base if k not in live)
+    assert stale == [], (
+        "baseline entries with no matching finding — regenerate with "
+        "`python tools/tpulint.py --update-baseline`:\n"
+        + "\n".join(stale))
