@@ -509,8 +509,19 @@ def config6_rados_bench(latency: float) -> dict:
     concurrency = 16
     write_secs = 8.0
 
+    # coalescing knobs (cluster/ecbatch.py): hold stripes up to the
+    # window/size target so writes from different ops share a device
+    # dispatch; op concurrency is what lets stripes meet in the window
+    batch_window_s = 0.01
+    batch_target_stripes = 48
+    op_concurrency = 32
+
     async def run_bench() -> dict:
-        c = TestCluster(n_osds=12)
+        c = TestCluster(n_osds=12, osd_conf={
+            "osd_ec_batch_window": batch_window_s,
+            "osd_ec_batch_target_stripes": batch_target_stripes,
+            "osd_op_concurrency": op_concurrency,
+        })
         await c.start()
         c.client.op_timeout = 60.0  # first-shape compiles are slow
         # stripe_unit 64 KiB (the reference's is pool-configurable the
@@ -562,15 +573,31 @@ def config6_rados_bench(latency: float) -> dict:
         await asyncio.gather(*(reader(n) for n in written))
         dt_r = time.perf_counter() - t0
 
-        batches = stripes = 0
+        batches = stripes = failures = 0
+        dec_batches = dec_stripes = 0
+        qwait_sum = qwait_n = 0.0
+        flush: dict[str, int] = {}
         for osd in c.osds:
             if osd is None:
                 continue
             d = osd.perf.dump()
             batches += int(d.get("ec_batches", 0))
+            failures += int(d.get("ec_batch_failures", 0))
+            dec_batches += int(d.get("ec_decode_batches", 0))
             h = d.get("ec_batch_stripes", {})
             if isinstance(h, dict):
                 stripes += int(h.get("sum", h.get("count", 0) or 0))
+            h = d.get("ec_decode_stripes", {})
+            if isinstance(h, dict):
+                dec_stripes += int(h.get("sum", 0))
+            h = d.get("ec_queue_wait_us", {})
+            if isinstance(h, dict):
+                qwait_sum += float(h.get("sum", 0.0))
+                qwait_n += float(h.get("count", 0))
+            for key, val in d.items():
+                if str(key).startswith("ec_flush_"):
+                    reason = str(key)[len("ec_flush_"):]
+                    flush[reason] = flush.get(reason, 0) + int(val)
         await c.stop()
         from ceph_tpu.ec import engine as ec_engine
 
@@ -592,6 +619,19 @@ def config6_rados_bench(latency: float) -> dict:
             "ec_stripes_batched": stripes,
             "stripes_per_batch": round(stripes / batches, 1)
             if batches else 0.0,
+            # WHY batches are the size they are (cluster/ecbatch.py):
+            # the flush-reason breakdown plus mean queue wait tells
+            # whether occupancy is window-bound, size-bound, or the
+            # mClock fast path is draining sparse cohorts
+            "ec_batch_failures": failures,
+            "ec_decode_batches": dec_batches,
+            "ec_decode_stripes": dec_stripes,
+            "flush_reasons": flush,
+            "batch_queue_wait_ms_mean": round(
+                qwait_sum / qwait_n / 1e3, 3) if qwait_n else 0.0,
+            "batch_window_s": batch_window_s,
+            "batch_target_stripes": batch_target_stripes,
+            "op_concurrency": op_concurrency,
         }
 
     return asyncio.run(run_bench())

@@ -37,6 +37,15 @@ _HOST_CALLS = frozenset((
     "np.copy", "numpy.copy",
 ))
 
+#: codec methods that dispatch a device program and return device
+#: arrays — materializing their result on the asyncio reactor thread
+#: blocks the whole daemon for the transfer+execution round trip
+#: (~0.5 s per batch on a tunnel-attached chip); the dispatch AND its
+#: readback belong in an executor worker (cluster/ecbatch.py shape)
+_DEVICE_DISPATCHES = frozenset((
+    "encode_batch", "decode_batch", "encode_crc_batch",
+))
+
 
 def _is_jit_expr(node: ast.AST) -> bool:
     """True for ``jax.jit`` / ``pjit`` possibly already applied
@@ -186,6 +195,9 @@ class TraceSafetyRule(Rule):
                     findings.extend(self._check_jitted(
                         node, info, path, ".".join(scope)))
                 else:
+                    if isinstance(node, ast.AsyncFunctionDef):
+                        findings.extend(self._check_reactor_readback(
+                            node, path, ".".join(scope)))
                     for c in ast.iter_child_nodes(node):
                         visit(c)
                 scope.pop()
@@ -250,6 +262,42 @@ class TraceSafetyRule(Rule):
                       else "nonlocal")
                 yield emit(node, f"`{kw}` state mutation inside jit is "
                                  "invisible to retraces")
+
+    def _check_reactor_readback(self, fn: ast.AsyncFunctionDef,
+                                path: str,
+                                symbol: str) -> Iterator[Finding]:
+        """A blocking device readback on the reactor thread: inside an
+        ``async def``, ``np.asarray(...)``/``np.array(...)`` wrapping a
+        batched device dispatch materializes the result synchronously —
+        the event loop stalls for the whole transfer+execution round
+        trip. The dispatch and its readback must run in an executor
+        worker (the ECBatcher _encode_sync/_decode_sync shape). The
+        walk stops at nested function boundaries (each def is checked
+        in its own visit)."""
+
+        def local_walk(node: ast.AST) -> Iterator[ast.AST]:
+            for c in ast.iter_child_nodes(node):
+                if isinstance(c, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                yield c
+                yield from local_walk(c)
+
+        for node in local_walk(fn):
+            if not (isinstance(node, ast.Call)
+                    and call_name(node.func) in _HOST_CALLS
+                    and node.args):
+                continue
+            for sub in ast.walk(node.args[0]):
+                if (isinstance(sub, ast.Call)
+                        and isinstance(sub.func, ast.Attribute)
+                        and sub.func.attr in _DEVICE_DISPATCHES):
+                    yield Finding(
+                        self.id, path, node.lineno, symbol,
+                        f"blocking device readback of "
+                        f"`.{sub.func.attr}()` on the reactor thread — "
+                        "dispatch + readback belong in an executor "
+                        "worker")
+                    break
 
     def _check_static_args(self, tree: ast.Module,
                            path: str) -> Iterator[Finding]:

@@ -1,0 +1,375 @@
+"""ECBatcher: the coalescing EC encode/decode dispatcher of the OSD
+data path.
+
+The TPU amortizes host<->device latency only when many stripes ride one
+dispatch, but the op stream hands the daemon stripes a few at a time.
+This module closes that gap NIC-interrupt-coalescing style:
+
+- **Cross-tick adaptive coalescing.** Stripes are held up to a size
+  target (``osd_ec_batch_target_stripes``) or a deadline
+  (``osd_ec_batch_window`` seconds) instead of flushing every reactor
+  tick. An mClock-aware fast-flush keeps latency honest: when the op
+  scheduler reports nothing else queued that could contribute stripes,
+  waiting out the window is pure added latency and the batch goes now.
+- **Double buffering.** While one batch is in flight on the executor,
+  the next accumulates; completion drains it immediately, so the
+  in-flight time itself is the accumulation window under load.
+- **Fused encode+CRC.** The device path dispatches ONE program that
+  returns parity cells AND the per-cell CRC32Cs of data+parity (the
+  bench's fused_stacked trick in the data path) — no second host pass
+  over the encoded cells. The host engine keeps its two-pass shape so
+  the engine-economics probe stays apples-to-apples.
+- **Batched decode.** Degraded reads, recovery and scrub repair submit
+  (B, k', su) rebuild batches through the same bucket/pow2-pad
+  machinery instead of one ``codec.decode`` per object; wanted parity
+  rows fold into the recovery matrix host-side (one stacked matmul).
+
+Buckets are keyed by a stable codec *profile* tuple, never ``id(codec)``
+— a GC'd codec's address can be reused by a different one, and two
+codecs from the same profile must share a bucket anyway.
+
+Perf counters (declared by :meth:`ECBatcher.declare_counters`) record
+batch occupancy, flush reason, queue wait, and failures, so the bench
+can report WHY batches are the size they are.
+"""
+from __future__ import annotations
+
+import asyncio
+import os
+
+import numpy as np
+
+from .. import native
+
+_FAILED = object()
+
+#: flush reasons, each with an ``ec_flush_<reason>`` counter:
+#: size      — the queued stripe count reached the target
+#: deadline  — the batch window expired
+#: fast      — mClock queue idle: nothing else could contribute stripes
+#: tick      — per-reactor-tick flush (window disabled)
+#: drain     — an in-flight batch completed and the next buffer flushed
+FLUSH_REASONS = ("size", "deadline", "fast", "tick", "drain")
+
+
+def codec_profile_key(codec) -> tuple:
+    """Stable bucket identity of a codec: exactly the fields that
+    determine its generator matrix and execution engine. ``id(codec)``
+    can alias two codecs if one is GC'd and a new one reuses the
+    address — the profile tuple cannot."""
+    return (
+        codec.profile.get("plugin", type(codec).__name__),
+        getattr(codec, "technique", ""),
+        codec.k,
+        codec.m,
+        getattr(codec, "backend", ""),
+    )
+
+
+class ECBatcher:
+    """Collects EC stripe work per (codec profile, cell geometry)
+    bucket and runs each bucket as one batched dispatch on the engine
+    the codec resolves to (device kernels, or the multithreaded C++
+    host core when the accelerator link loses the measured-economics
+    probe — ec/engine.py). Dispatch + readback run in a worker thread
+    so the reactor keeps serving ops while batches are in flight."""
+
+    def __init__(self, perf=None, conf=None, idle_probe=None) -> None:
+        #: bucket key -> [(codec, cells, fut, t_enqueue)]
+        self._pending: dict[tuple, list] = {}
+        #: bucket key -> (reason, TimerHandle) for an armed flush timer
+        self._timers: dict[tuple, tuple] = {}
+        self._scheduled: set[tuple] = set()
+        self._inflight: set[tuple] = set()
+        #: ops currently parked on a batcher future (queued OR riding
+        #: an in-flight dispatch) — the daemon's idle probe compares
+        #: this against its op-tracker to tell "everyone who could
+        #: contribute stripes is already aboard" from "more coming"
+        self._parked = 0
+        self.perf = perf
+        self.conf = conf
+        #: () -> bool: True when the op scheduler has nothing queued
+        #: that could contribute more stripes (mClock-aware fast flush)
+        self.idle_probe = idle_probe
+
+    @staticmethod
+    def declare_counters(perf) -> None:
+        """Declare every counter this batcher mutates (shared by the
+        daemon and the unit tests so the two can never drift)."""
+        perf.add_u64_counter("ec_batches", "batched EC encode dispatches")
+        perf.add_histogram("ec_batch_stripes", "stripes per EC encode batch")
+        perf.add_u64_counter("ec_batch_failures",
+                             "EC batch dispatches that failed")
+        perf.add_u64_counter("ec_decode_batches",
+                             "batched EC decode dispatches")
+        perf.add_histogram("ec_decode_stripes",
+                           "stripes per EC decode batch")
+        perf.add_histogram("ec_queue_wait_us",
+                           "per-stripe-group wait in the batch queue (us)")
+        for reason in FLUSH_REASONS:
+            perf.add_u64_counter(f"ec_flush_{reason}",
+                                 f"EC batch flushes triggered by {reason}")
+
+    # ------------------------------------------------------------ knobs
+
+    def _target_stripes(self) -> int:
+        if self.conf is None:
+            return 0
+        try:
+            return int(self.conf["osd_ec_batch_target_stripes"])
+        except Exception:
+            return 0
+
+    def _window(self) -> float:
+        if self.conf is None:
+            return 0.0
+        try:
+            return float(self.conf["osd_ec_batch_window"])
+        except Exception:
+            return 0.0
+
+    # ------------------------------------------------------- submission
+
+    async def encode_cells(self, codec, cells: np.ndarray):
+        """(B, k, su) uint8 data cells -> (parity, crcs):
+        parity (B, m, su) uint8; crcs (B, k+m) uint32 per-cell CRC32Cs
+        of data+parity from the fused device dispatch, or None on the
+        host engine (whose callers keep their own multithreaded CRC
+        pass — the engine economics stay apples-to-apples).
+
+        The fixed stripe_unit layout (cluster/stripe.py) means every
+        caller in the cluster shares one cell shape, so stripes from
+        different objects/PGs/ticks merge into ONE dispatch of ONE
+        compiled kernel shape."""
+        key = ("enc", codec_profile_key(codec), cells.shape[-1])
+        return await self._submit(key, codec, cells)
+
+    async def decode_cells(self, codec, present, want,
+                           cells: np.ndarray) -> np.ndarray:
+        """(B, k', su) uint8 surviving cells -> (B, len(want), su)
+        uint8 rebuilt cells. ``present`` are the generator indices of
+        the survivor rows (exactly k of them), ``want`` the generator
+        indices to rebuild — parity rows fold into the recovery matrix
+        host-side, so a wanted parity chunk is STILL one matmul."""
+        key = ("dec", codec_profile_key(codec), cells.shape[-1],
+               tuple(present), tuple(want))
+        return await self._submit(key, codec, cells)
+
+    def parked(self) -> int:
+        """Ops currently awaiting a batcher future (see _parked).
+
+        Counts BOTH client encode/decode waits and background
+        (recovery/scrub) decode waits — the idle probe compares this
+        against the client-only op tracker, so a parked background
+        decode can make the probe read "idle" one op early and settle-
+        flush a slightly smaller batch. That erring direction costs a
+        little occupancy, never latency, and the size/deadline triggers
+        still bound both."""
+        return self._parked
+
+    def close(self) -> None:
+        """Daemon shutdown: cancel armed flush timers/scheduled flushes
+        and fail every queued waiter so nothing fires into a stopped
+        daemon or hangs a caller. In-flight executor batches finish on
+        their own; their completion drain finds the queues empty."""
+        for _, handle in self._timers.values():
+            handle.cancel()
+        self._timers.clear()
+        self._scheduled.clear()
+        pending, self._pending = self._pending, {}
+        for items in pending.values():
+            for _, _, fut, _ in items:
+                if not fut.done():
+                    fut.set_result(_FAILED)
+
+    async def _submit(self, key: tuple, codec, cells: np.ndarray):
+        loop = asyncio.get_running_loop()
+        fut = loop.create_future()
+        self._pending.setdefault(key, []).append(
+            (codec, np.ascontiguousarray(cells), fut, loop.time()))
+        self._parked += 1
+        try:
+            self._poke(key)
+            result = await fut
+        finally:
+            self._parked -= 1
+        if result is _FAILED:
+            raise RuntimeError("batched EC dispatch failed")
+        return result
+
+    # ---------------------------------------------------- flush policy
+
+    def _poke(self, key: tuple, drain: bool = False) -> None:
+        """Decide whether the bucket flushes now, later, or not yet."""
+        queue = self._pending.get(key)
+        if not queue or key in self._scheduled:
+            return
+        if key in self._inflight:
+            return  # double-buffer: accumulate; completion drains us
+        if drain:
+            self._arm_now(key, "drain")
+            return
+        target = self._target_stripes()
+        if target > 0 and sum(len(c) for _, c, _, _ in queue) >= target:
+            self._arm_now(key, "size")
+            return
+        window = self._window()
+        if window <= 0:
+            self._arm_now(key, "tick")
+            return
+        armed = self._timers.get(key)
+        if self.idle_probe is not None and self.idle_probe():
+            # nothing else queued that could contribute stripes: do NOT
+            # wait out the window — but settle for a few ms first, so a
+            # cohort still in client transit (invisible to the op
+            # tracker until it arrives) can land in the same batch
+            # (adaptive interrupt coalescing, not a bare fast path).
+            # An already-armed fast timer stays: re-arming on every
+            # arrival would defer the flush unboundedly.
+            if armed is None or armed[0] == "deadline":
+                if armed is not None:
+                    armed[1].cancel()
+                settle = min(window * 0.1, 0.005)
+                self._timers[key] = ("fast",
+                                     asyncio.get_running_loop().call_later(
+                                         settle, self._flush, key, "fast"))
+            return
+        if armed is None:
+            self._timers[key] = ("deadline",
+                                 asyncio.get_running_loop().call_later(
+                                     window, self._flush, key, "deadline"))
+
+    def _arm_now(self, key: tuple, reason: str) -> None:
+        """Flush on the next tick (coalesces same-tick submissions)."""
+        self._scheduled.add(key)
+        asyncio.get_running_loop().call_soon(self._flush, key, reason)
+
+    def _flush(self, key: tuple, reason: str) -> None:
+        self._scheduled.discard(key)
+        timer = self._timers.pop(key, None)
+        if timer is not None:
+            timer[1].cancel()
+        items = self._pending.pop(key, None)
+        if not items:
+            return
+        if key in self._inflight:
+            # a deadline fired while the drain path held the bucket:
+            # put the work back; completion will drain it
+            self._pending.setdefault(key, [])[:0] = items
+            return
+        self._inflight.add(key)
+        if self.perf is not None:
+            self.perf.inc(f"ec_flush_{reason}")
+        asyncio.get_running_loop().create_task(self._run(key, items))
+
+    # ------------------------------------------------------- execution
+
+    async def _run(self, key: tuple, items: list) -> None:
+        loop = asyncio.get_running_loop()
+        if self.perf is not None:
+            now = loop.time()
+            for _, _, _, t0 in items:
+                self.perf.observe("ec_queue_wait_us",
+                                  max(0.0, (now - t0) * 1e6))
+        kind = key[0]
+        codec = items[0][0]
+        cells = (items[0][1] if len(items) == 1
+                 else np.concatenate([c for _, c, _, _ in items]))
+        try:
+            if kind == "enc":
+                out = await loop.run_in_executor(
+                    None, self._encode_sync, codec, cells)
+            else:
+                out = await loop.run_in_executor(
+                    None, self._decode_sync, codec, key[3], key[4], cells)
+        except Exception:
+            # failed dispatches are NOT throughput: count the failure,
+            # never the batch, and reject every waiter exactly once
+            if self.perf is not None:
+                self.perf.inc("ec_batch_failures")
+            for _, _, fut, _ in items:
+                if not fut.done():
+                    fut.set_result(_FAILED)
+            return
+        finally:
+            self._inflight.discard(key)
+            self._poke(key, drain=True)
+        # perf accounting strictly after success
+        if self.perf is not None:
+            if kind == "enc":
+                self.perf.inc("ec_batches")
+                self.perf.observe("ec_batch_stripes", len(cells))
+            else:
+                self.perf.inc("ec_decode_batches")
+                self.perf.observe("ec_decode_stripes", len(cells))
+        row = 0
+        for _, c, fut, _ in items:
+            b = len(c)
+            if not fut.done():
+                if kind == "enc":
+                    parity, crcs = out
+                    fut.set_result((
+                        parity[row : row + b],
+                        None if crcs is None else crcs[row : row + b]))
+                else:
+                    fut.set_result(out[row : row + b])
+            row += b
+
+    # ------------------------------------------------- sync kernels
+    # (worker-thread only: both the C++ core — ctypes releases the
+    # GIL — and the jax transfer/readback overlap the reactor; on a
+    # tunnel-attached chip a reactor-thread readback froze the whole
+    # OSD for ~0.5 s per batch)
+
+    @staticmethod
+    def _pow2_pad(batch: np.ndarray) -> np.ndarray:
+        """Pad the batch axis to a power of two: jit specializes per
+        shape, and on a tunnel-attached chip each fresh batch size
+        costs a ~2 s compile — pow2 bucketing caps that at
+        log2(max batch) compiles (zero stripes encode/decode to zero
+        cells and are sliced away by the caller)."""
+        n = len(batch)
+        target = 1 << max(0, (n - 1)).bit_length()
+        if target == n:
+            return batch
+        pad = np.zeros((target - n,) + batch.shape[1:], dtype=batch.dtype)
+        return np.concatenate([batch, pad])
+
+    @staticmethod
+    def _encode_sync(codec, cells: np.ndarray):
+        """(B, k, su) u8 -> (parity (B, m, su) u8, crcs | None)."""
+        engine = getattr(codec, "resolved_backend", lambda: "device")()
+        b, k, su = cells.shape
+        if engine == "host" or not hasattr(codec, "encode_crc_batch"):
+            flat = np.ascontiguousarray(
+                cells.transpose(1, 0, 2)).reshape(k, b * su)
+            par = native.rs_encode(codec.matrix, flat,
+                                   threads=os.cpu_count() or 1)
+            parity = np.ascontiguousarray(
+                par.reshape(codec.m, b, su).transpose(1, 0, 2))
+            return parity, None
+        from ..ops import rs
+
+        batch = ECBatcher._pow2_pad(rs.pack_u32(cells))
+        parity_w, crcs = codec.encode_crc_batch(batch, su)
+        return (rs.unpack_u32(np.asarray(parity_w)[:b]),
+                np.asarray(crcs)[:b])
+
+    @staticmethod
+    def _decode_sync(codec, present: tuple, want: tuple,
+                     cells: np.ndarray) -> np.ndarray:
+        """(B, k', su) u8 survivors -> (B, len(want), su) u8."""
+        engine = getattr(codec, "resolved_backend", lambda: "device")()
+        b, kp, su = cells.shape
+        if engine == "host" or not hasattr(codec, "decode_batch"):
+            mat = codec.decode_matrix_for(present, want)
+            flat = np.ascontiguousarray(
+                cells.transpose(1, 0, 2)).reshape(kp, b * su)
+            out = native.rs_matmul(mat, flat, threads=os.cpu_count() or 1)
+            return np.ascontiguousarray(
+                out.reshape(len(want), b, su).transpose(1, 0, 2))
+        from ..ops import rs
+
+        batch = ECBatcher._pow2_pad(rs.pack_u32(cells))
+        out = codec.decode_batch(present, batch, want=want)
+        return rs.unpack_u32(np.asarray(out)[:b])

@@ -6,11 +6,14 @@ ops / sub-ops / peering traffic to PGs. Heartbeats flow OSD->mon; send
 failures to peers are reported as MFailure (the send_failures ->
 prepare_failure arc, OSD.cc:7099, OSDMonitor.cc:3325).
 
-The ECBatcher here is the TPU-native heart of the write path: every EC
-stripe submitted during one reactor tick is encoded in ONE batched
-device dispatch (ceph_tpu.ec encode_batch over (B, k, W) uint32), which
-is how the framework amortizes host<->device latency that a per-stripe
-codec call (the reference's jerasure path) cannot.
+The ECBatcher (cluster/ecbatch.py) is the TPU-native heart of the write
+path: EC stripes submitted across reactor ticks coalesce into ONE
+batched device dispatch per bucket (fused encode+CRC over (B, k, W)
+uint32, size-target/deadline/fast-flush policy), which is how the
+framework amortizes host<->device latency that a per-stripe codec call
+(the reference's jerasure path) cannot. The op worker dispatches up to
+osd_op_concurrency ops from the mClock queue concurrently so stripes
+from different client ops can meet in the same batch.
 """
 from __future__ import annotations
 
@@ -20,9 +23,6 @@ import sys
 import time
 import traceback
 
-import numpy as np
-
-from .. import native
 from ..ec import load_codec
 from ..placement import encoding as menc
 from ..placement.osdmap import PlacementMemo
@@ -34,113 +34,15 @@ from ..utils import trace
 from ..utils.fault import FaultInjector
 from ..utils.perf import PerfCounters
 from . import messages as M
+from .ecbatch import ECBatcher  # noqa: F401  (re-export: the public seam)
 from .optracker import OpTracker
 from .pg import NONE, PG
 from .scheduler import CLIENT, RECOVERY, SCRUB, MClockScheduler, Throttle
-
-_FAILED = object()
 
 
 def _op_bytes(msg) -> int:
     """Payload bytes of an op vector (throttle accounting)."""
     return sum(len(o[4]) for o in msg.ops)
-
-
-class ECBatcher:
-    """Collects EC stripes for one reactor tick, encodes them as one
-    batch per (codec profile, chunk words) bucket.
-
-    The batch runs on the engine the codec resolves to — the device
-    kernels, or the multithreaded C++ host core when the accelerator
-    link loses the measured-economics probe (ec/engine.py; the
-    reference's ISA-L-vs-jerasure runtime pick). Either way the encode
-    and its readback run in a worker thread, so the reactor keeps
-    serving ops while stripes are in flight — on a tunnel-attached chip
-    a blocking readback froze the whole OSD for ~0.5 s per batch."""
-
-    def __init__(self, perf=None) -> None:
-        self._pending: dict[tuple, list] = {}
-        self._flushing = False
-        self.perf = perf
-
-    async def encode_cells(self, codec, cells: np.ndarray) -> np.ndarray:
-        """(B, k, su) uint8 data cells -> (B, m, su) uint8 parity cells.
-
-        The fixed stripe_unit layout (cluster/stripe.py) means every
-        caller in the cluster shares one cell shape, so stripes from
-        different objects/PGs submitted in the same reactor tick merge
-        into ONE dispatch of ONE compiled kernel shape."""
-        key = (id(codec), cells.shape[-1])
-        fut = asyncio.get_running_loop().create_future()
-        self._pending.setdefault(key, []).append(
-            (codec, np.ascontiguousarray(cells), fut))
-        if not self._flushing:
-            self._flushing = True
-            asyncio.get_running_loop().call_soon(self._flush)
-        parity = await fut
-        if parity is _FAILED:
-            raise RuntimeError("batched encode failed")
-        return parity
-
-    def _flush(self) -> None:
-        self._flushing = False
-        pending, self._pending = self._pending, {}
-        loop = asyncio.get_running_loop()
-        for (_cid, _su), items in pending.items():
-            loop.create_task(self._encode_bucket(items))
-
-    async def _encode_bucket(self, items: list) -> None:
-        codec = items[0][0]
-        cells = (items[0][1] if len(items) == 1
-                 else np.concatenate([c for _, c, _ in items]))
-        if self.perf is not None:
-            self.perf.inc("ec_batches")
-            self.perf.observe("ec_batch_stripes", len(cells))
-        try:
-            parity = await asyncio.get_running_loop().run_in_executor(
-                None, self._encode_sync, codec, cells)
-        except Exception:
-            for _, _, fut in items:
-                if not fut.done():
-                    fut.set_result(_FAILED)
-            return
-        row = 0
-        for _, c, fut in items:
-            b = len(c)
-            if not fut.done():
-                fut.set_result(parity[row : row + b])
-            row += b
-
-    @staticmethod
-    def _encode_sync(codec, cells: np.ndarray) -> np.ndarray:
-        """(B, k, su) u8 -> (B, m, su) u8, on the resolved engine.
-        Runs in a worker thread: both the C++ core (ctypes releases the
-        GIL) and the jax transfer/readback overlap the reactor."""
-        engine = getattr(codec, "resolved_backend", lambda: "device")()
-        if engine == "host":
-            b, k, su = cells.shape
-            flat = np.ascontiguousarray(
-                cells.transpose(1, 0, 2)).reshape(k, b * su)
-            par = native.rs_encode(codec.matrix, flat,
-                                   threads=os.cpu_count() or 1)
-            return np.ascontiguousarray(
-                par.reshape(codec.m, b, su).transpose(1, 0, 2))
-        from ..ops import rs
-
-        batch = rs.pack_u32(cells)
-        # pad the batch axis to a power of two: jit specializes per
-        # shape, and on a tunnel-attached chip each fresh batch size
-        # costs a ~2 s compile — pow2 bucketing caps that at
-        # log2(max batch) compiles (zero stripes encode to zero
-        # parity and are sliced away below)
-        n = len(batch)
-        target = 1 << max(0, (n - 1)).bit_length()
-        if target != n:
-            pad = np.zeros((target - n,) + batch.shape[1:],
-                           dtype=batch.dtype)
-            batch = np.concatenate([batch, pad])
-        parity = np.asarray(codec.encode_batch(batch))
-        return rs.unpack_u32(parity[:n])
 
 
 class OSDLite:
@@ -189,13 +91,29 @@ class OSDLite:
             "osd_max_backfills",
             lambda _n, v: (self.local_reserver.set_max(v),
                            self.remote_reserver.set_max(v)))
-        self.ec_batcher = ECBatcher(self.perf)
         #: per-epoch placement memo (the daemon's map only moves
         #: by epochs, so memoizing pg->up/acting is safe here)
         self.placement = PlacementMemo()
         self.admin: AdminSocket | None = None
         # QoS between client / recovery / scrub traffic (mClock role)
         self.op_scheduler = MClockScheduler()
+        #: client write ops currently waiting on a PG lock (see
+        #: pg.do_op): they cannot contribute EC stripes until the
+        #: holder's batch flushes, so the batcher's idle probe counts
+        #: them as already-accounted-for rather than as "more coming"
+        self.op_lock_waiters = 0
+        # the coalescing EC dispatcher; the idle probe is what makes its
+        # fast-flush mClock-aware — when the mClock queue is empty AND
+        # every in-flight client op is either parked on a batcher
+        # future or blocked behind one on a PG lock, nothing else can
+        # contribute stripes, so waiting out the window would be pure
+        # added latency for the parked ops
+        self.ec_batcher = ECBatcher(
+            self.perf, conf=self.conf,
+            idle_probe=lambda: (
+                len(self.op_scheduler) == 0
+                and len(self.optracker.in_flight)
+                <= self.ec_batcher.parked() + self.op_lock_waiters))
         self.throttle = Throttle(self.conf["osd_client_message_size_cap"])
         self.optracker = OpTracker()
         self.tracer = trace.get_tracer(self.name)
@@ -208,7 +126,7 @@ class OSDLite:
         #: pool id -> pg_num last seen (detects split transitions)
         self._pool_pg_num: dict[int, int] = {}
         self._hb_task: asyncio.Task | None = None
-        self._worker_task: asyncio.Task | None = None
+        self._worker_tasks: list[asyncio.Task] = []
         self._tasks: set[asyncio.Task] = set()
         self.stopped = False
         self._pool_stats_ts = 0.0
@@ -223,8 +141,7 @@ class OSDLite:
         p.add_u64_counter("op_w", "client writes")
         p.add_time_avg("op_latency", "client op latency")
         p.add_u64_counter("subop_w", "replica/shard sub-writes applied")
-        p.add_u64_counter("ec_batches", "batched EC device dispatches")
-        p.add_histogram("ec_batch_stripes", "stripes per EC batch")
+        ECBatcher.declare_counters(p)
         p.add_u64_counter("recovery_pushes", "objects pushed to peers")
         p.add_u64_counter("recovery_unfound",
                           "objects skipped as unrecoverable")
@@ -370,13 +287,28 @@ class OSDLite:
         self._hb_task = asyncio.get_running_loop().create_task(
             self._hb_loop()
         )
-        self._worker_task = asyncio.get_running_loop().create_task(
-            self._op_worker()
-        )
+        # a small worker POOL (the ShardedOpWQ shard role): admission
+        # order still comes from one mClock queue, but up to
+        # osd_op_concurrency ops execute concurrently — which is what
+        # lets EC stripes from different ops meet in one device batch.
+        # Ordering contract: writes (and EC reads) serialize per-PG on
+        # the PG lock; ops a client submits SEQUENTIALLY (awaiting each
+        # reply) stay ordered trivially. Ops a client deliberately
+        # submits concurrently against one object have no submission-
+        # order guarantee (a pre-lock await like map catch-up can
+        # reorder them) — each applies atomically and the reply order
+        # matches the apply order, so the later-acked write wins, the
+        # same contract concurrent submissions get from librados.
+        nworkers = max(1, int(self.conf["osd_op_concurrency"]))
+        self._worker_tasks = [
+            asyncio.get_running_loop().create_task(self._op_worker())
+            for _ in range(nworkers)
+        ]
 
     async def _op_worker(self) -> None:
         """Drain the mClock queue (the ShardedOpWQ::_process role,
-        OSD.cc:10859): one decision at a time, QoS between classes."""
+        OSD.cc:10859): each worker takes one scheduling decision at a
+        time; QoS between classes is decided at dequeue."""
         while True:
             fn = await self.op_scheduler.get()
             try:
@@ -496,8 +428,10 @@ class OSDLite:
             self.admin = None
         if self._hb_task:
             self._hb_task.cancel()
-        if self._worker_task:
-            self._worker_task.cancel()
+        for t in self._worker_tasks:
+            t.cancel()
+        self._worker_tasks = []
+        self.ec_batcher.close()
         for t in list(self._tasks):
             t.cancel()
         self.bus.unregister(self.name)

@@ -637,11 +637,33 @@ class PG:
         t0 = time.perf_counter()
         snapc = (m.snap_seq, list(m.snaps))
         try:
-            if write_class:
-                async with self.lock:
+            if write_class or self.is_ec:
+                # writes serialize per-PG; EC READS do too — an EC read
+                # gathers cells across SEVERAL shard stores, and a
+                # concurrent write's multi-shard fanout is not atomic
+                # across them, so an unlocked read racing a write could
+                # mix old and new cells (torn read / spurious hinfo
+                # failures) now that the op worker dispatches ops
+                # concurrently. The reference takes per-object rw locks
+                # (obc); the lite PG serializes on the PG lock.
+                # Replicated reads hit ONE store (each write lands
+                # there as one atomic transaction) and skip the lock.
+                # The waiter count feeds the ECBatcher's mClock-aware
+                # fast-flush: an op parked here cannot contribute
+                # stripes until the lock holder's batch flushes, so the
+                # batcher must not hold a batch open waiting for it.
+                self.osd.op_lock_waiters += 1
+                try:
+                    await self.lock.acquire()
+                finally:
+                    self.osd.op_lock_waiters -= 1
+                try:
                     outs, size = await self._execute_ops(
                         m.oid, m.ops, src=src, snapc=snapc,
-                        snapid=m.snapid, reqid=(src, m.tid))
+                        snapid=m.snapid,
+                        reqid=(src, m.tid) if write_class else ("", 0))
+                finally:
+                    self.lock.release()
             else:
                 outs, size = await self._execute_ops(
                     m.oid, m.ops, src=src, snapc=snapc, snapid=m.snapid)
@@ -1219,23 +1241,32 @@ class PG:
         # Shard-major layout: one transpose copy gives every shard's
         # cells as a CONTIGUOUS (T, su) block, so each write run below
         # is one slice.tobytes() instead of a per-cell tobytes + join
-        # (the round-5 profile's dominant remaining memcpy), and the
-        # per-cell integrity CRCs batch into one multithreaded native
-        # call per side. A zero cell's CRC equals zero_cell_crc, so no
-        # special-casing.
+        # (the round-5 profile's dominant remaining memcpy). A zero
+        # cell's CRC equals zero_cell_crc, so no special-casing.
         if tlist:
-            parity = await osd.ec_batcher.encode_cells(codec, cells)
+            parity, fused = await osd.ec_batcher.encode_cells(codec,
+                                                              cells)
             data_sh = np.ascontiguousarray(
                 cells.transpose(1, 0, 2))          # (k, T, su)
             par_sh = np.ascontiguousarray(
                 parity.transpose(1, 0, 2))         # (m, T, su)
-            nthr = _os.cpu_count() or 1
-            crc_d = native.crc32c_batch(
-                data_sh.reshape(-1, si.su), threads=nthr
-            ).reshape(k, len(tlist))
-            crc_p = native.crc32c_batch(
-                par_sh.reshape(-1, si.su), threads=nthr
-            ).reshape(n - k, len(tlist))
+            if fused is not None:
+                # device engine: the per-cell hash_info CRCs came back
+                # from the SAME fused dispatch as the parity — no
+                # second pass over the encoded cells on the host
+                crc_d = np.ascontiguousarray(fused[:, :k].T)   # (k, T)
+                crc_p = np.ascontiguousarray(fused[:, k:].T)   # (m, T)
+            else:
+                # host engine: one multithreaded native CRC call per
+                # side (kept two-pass so the engine-economics probe
+                # stays apples-to-apples with the C++ core)
+                nthr = _os.cpu_count() or 1
+                crc_d = native.crc32c_batch(
+                    data_sh.reshape(-1, si.su), threads=nthr
+                ).reshape(k, len(tlist))
+                crc_p = native.crc32c_batch(
+                    par_sh.reshape(-1, si.su), threads=nthr
+                ).reshape(n - k, len(tlist))
             nz_d = data_sh.any(axis=2)             # (k, T)
             nz_p = par_sh.any(axis=2)              # (m, T)
         shard_txns: dict[int, tx.Transaction] = {}
@@ -1544,14 +1575,25 @@ class PG:
         # equalize lengths defensively (lagging shards), then decode
         want_missing = [p for p in want if p not in chunks]
         if want_missing:
+            # batched rebuild of ONLY the missing rows: the touched
+            # stripes become a (ncells, k, su) batch through the
+            # ECBatcher's bucket/pow2 machinery, merging with every
+            # other degraded read / recovery decode in flight instead
+            # of one codec.decode dispatch per object; already-fetched
+            # shards pass through untouched
             maxlen = max(len(c) for c in chunks.values())
-            arrs = {
-                p: np.frombuffer(
-                    c.ljust(maxlen, b"\0"), dtype=np.uint8
-                )
-                for p, c in chunks.items()
+            missing_g = tuple(codec._position_to_generator(p)
+                              for p in want_missing)
+            rebuilt = await self._decode_cells_batched(
+                codec, si, chunks, maxlen, want_generators=missing_g)
+            decoded = {
+                p: rebuilt[:, i, :].reshape(-1)
+                for i, p in enumerate(want_missing)
             }
-            decoded = codec.decode(want, arrs)
+            for p in want:
+                if p in chunks:
+                    decoded[p] = np.frombuffer(chunks[p],
+                                               dtype=np.uint8)
         else:
             decoded = {
                 p: np.frombuffer(chunks[p], dtype=np.uint8)
@@ -1568,6 +1610,48 @@ class PG:
         ).reshape(-1)
         lo = offset - s0 * si.width
         return bytes(logical[lo : lo + (end - offset)]), size
+
+    async def _decode_cells_batched(self, codec, si, chunks: dict,
+                                    maxlen: int,
+                                    want_generators: tuple) -> np.ndarray:
+        """Rebuild ``want_generators`` rows from the survivor chunks via
+        the ECBatcher decode side: chunk byte-ranges become a
+        (ncells, k, su) cell batch (short chunks zero-extended to
+        ``maxlen``), so concurrent degraded reads, recovery pulls and
+        scrub repairs merge into one stacked-matrix device dispatch.
+        Codecs without the batched bytewise API (bitmatrix, CLAY, ...)
+        fall back to one scalar ``codec.decode`` here, so every caller
+        shares ONE eligibility rule. Returns (ncells, len(want), su)
+        uint8."""
+        ncells = -(-maxlen // si.su)
+        if ncells == 0:  # nothing fetched anywhere: nothing to rebuild
+            return np.zeros((0, len(want_generators), si.su),
+                            dtype=np.uint8)
+        if (getattr(codec, "bytewise_linear", False)
+                and hasattr(codec, "decode_batch")):
+            order = sorted(chunks)[: codec.k]  # any k rows decode (MDS)
+            present = tuple(codec._position_to_generator(p)
+                            for p in order)
+            surv = np.zeros((len(order), ncells * si.su), dtype=np.uint8)
+            for row, p in enumerate(order):
+                c = np.frombuffer(chunks[p], dtype=np.uint8)
+                surv[row, : c.size] = c
+            surv = np.ascontiguousarray(
+                surv.reshape(len(order), ncells, si.su).transpose(1, 0, 2))
+            return await self.osd.ec_batcher.decode_cells(
+                codec, present, want_generators, surv)
+        arrs = {
+            p: np.frombuffer(c.ljust(maxlen, b"\0"), dtype=np.uint8)
+            for p, c in chunks.items()
+        }
+        positions = [codec.chunk_index(g) for g in want_generators]
+        decoded = codec.decode(positions, arrs)
+        out = np.zeros((ncells, len(positions), si.su), dtype=np.uint8)
+        for i, p in enumerate(positions):
+            row = np.zeros(ncells * si.su, dtype=np.uint8)
+            row[: decoded[p].size] = decoded[p]
+            out[:, i, :] = row.reshape(ncells, si.su)
+        return out
 
     def _verify_hinfo(self, cid: str, oid: bytes, chunk: bytes,
                       first_cell: int = 0) -> None:
@@ -2190,16 +2274,21 @@ class PG:
 
     async def _recover_own_chunk(self, oid: bytes,
                                  version: tuple[int, int]) -> None:
-        chunk, attrs = await self._reconstruct_chunk(oid, self.shard)
-        t = tx.Transaction()
-        self._ensure_coll(t)
-        t.truncate(self.cid, oid, 0)
-        t.write(self.cid, oid, 0, chunk)
-        # wipe first: attrs the survivors DON'T have (stale ss / wh
-        # from our pre-crash copy) must not outlive recovery
-        t.rmattrs(self.cid, oid)
-        t.setattrs(self.cid, oid, {**attrs, ATTR_V: enc_ver(version)})
-        self.osd.store.queue_transaction(t)
+        # under the PG lock: a reconstruct racing a concurrent client
+        # write's multi-shard fanout (scrub repair runs while active)
+        # would decode a mix of old and new cells and PERSIST it under
+        # freshly computed — self-consistent — hinfo CRCs
+        async with self.lock:
+            chunk, attrs = await self._reconstruct_chunk(oid, self.shard)
+            t = tx.Transaction()
+            self._ensure_coll(t)
+            t.truncate(self.cid, oid, 0)
+            t.write(self.cid, oid, 0, chunk)
+            # wipe first: attrs the survivors DON'T have (stale ss / wh
+            # from our pre-crash copy) must not outlive recovery
+            t.rmattrs(self.cid, oid)
+            t.setattrs(self.cid, oid, {**attrs, ATTR_V: enc_ver(version)})
+            self.osd.store.queue_transaction(t)
 
     async def _backfill_peer(self, o: int, s: int) -> None:
         """Push every object to a peer whose log diverged past our tail
@@ -2236,7 +2325,15 @@ class PG:
         if e.op == OP_DELETE:
             data, attrs = None, {}
         elif self.is_ec:
-            data, attrs = await self._reconstruct_chunk(oid, s)
+            # under the PG lock: reconstructing while a client write's
+            # fanout is mid-flight (pg_temp migration and scrub repair
+            # push while active) must not mix generations — the pushed
+            # chunk would carry fresh self-consistent hinfo over torn
+            # data. The send/ack below stays OUTSIDE the lock; a write
+            # landing after reconstruct bumps the version and the
+            # callers' version re-check / push version guard handle it.
+            async with self.lock:
+                data, attrs = await self._reconstruct_chunk(oid, s)
         else:
             try:
                 data = bytes(osd.store.read(self.cid, oid))
@@ -2334,13 +2431,15 @@ class PG:
         if size_attr is None:
             size_attr = denc.enc_u64(remote_size or 0)
         maxlen = max(len(c) for c in chunks.values()) if chunks else 0
-        arrs = {
-            p: np.frombuffer(c.ljust(maxlen, b"\0"), dtype=np.uint8)
-            for p, c in chunks.items()
-        }
-        decoded = codec.decode([shard], arrs)
-        chunk = decoded[shard].tobytes()
         si = self.osd.sinfo_for(self.pool)
+        # batched rebuild through the ECBatcher (one stacked-matrix
+        # dispatch shared with every other decode in flight); a wanted
+        # PARITY shard folds into the recovery matrix, so it is still
+        # a single matmul, not decode-then-re-encode
+        g = codec._position_to_generator(shard)
+        rebuilt = await self._decode_cells_batched(
+            codec, si, chunks, maxlen, want_generators=(g,))
+        chunk = rebuilt[:, 0, :].reshape(-1)[:maxlen].tobytes()
         return chunk, {
             **user_attrs,
             ATTR_SIZE: size_attr,

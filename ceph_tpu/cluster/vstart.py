@@ -36,10 +36,15 @@ class TestCluster:
                  out_interval: float = 4.0, hb_interval: float = 0.15,
                  crush: cm.CrushMap | None = None, n_mons: int = 1,
                  objectstore: str = "memstore",
-                 data_dir: str | None = None, **store_kw):
+                 data_dir: str | None = None,
+                 osd_conf: dict | None = None, **store_kw):
         self.bus = LocalBus()
         self.n_osds = n_osds
         self.n_mons = n_mons
+        #: config overrides applied to every OSD before it boots (the
+        #: vstart.sh `-o key=value` role) — e.g. the EC batch
+        #: coalescing knobs or osd_op_concurrency
+        self.osd_conf = dict(osd_conf or {})
 
         def _mon_store(rank: int):
             # durable clusters put mon state on the native kv too
@@ -137,8 +142,14 @@ class TestCluster:
                 s.umount()
 
     async def start_osd(self, i: int) -> OSDLite:
+        conf = None
+        if self.osd_conf:
+            from ..utils import config as cfg
+
+            conf = cfg.proxy()
+            conf.apply(self.osd_conf)
         osd = OSDLite(self.bus, i, store=self.stores[i],
-                      hb_interval=self.hb_interval)
+                      hb_interval=self.hb_interval, conf=conf)
         self.osds[i] = osd
         await osd.start()
         return osd

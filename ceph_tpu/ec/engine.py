@@ -48,18 +48,29 @@ def _probe() -> str:
     rng = np.random.default_rng(1)
     batch = rng.integers(0, 2**32, (_PROBE_B, _PROBE_K, _PROBE_WORDS),
                          dtype=np.uint32)
+    cell_bytes = _PROBE_WORDS * 4
 
     def dev_once() -> float:
+        # the FUSED data-path dispatch: put + encode + per-cell CRC
+        # kernel + readback of parity AND crcs — what the write path
+        # actually ships per batch (cluster/ecbatch.py)
         t0 = time.perf_counter()
-        np.asarray(rs.encode(matrix, batch))  # put + kernel + readback
+        parity, crcs = rs.jit_encode_with_crcs(matrix, cell_bytes)(batch)
+        np.asarray(parity)
+        np.asarray(crcs)
         return time.perf_counter() - t0
 
     def host_once() -> float:
+        # the host engine's two-pass shape: multithreaded C++ encode,
+        # then the separate multithreaded CRC pass over data+parity
+        # cells — apples-to-apples with what the host data path costs
         u8 = np.ascontiguousarray(
             batch.view(np.uint8).reshape(_PROBE_B, _PROBE_K, -1)
             .transpose(1, 0, 2)).reshape(_PROBE_K, -1)
         t0 = time.perf_counter()
-        native.rs_encode(matrix, u8, threads=os.cpu_count() or 1)
+        par = native.rs_encode(matrix, u8, threads=os.cpu_count() or 1)
+        cells = np.concatenate([u8, par]).reshape(-1, cell_bytes)
+        native.crc32c_batch(cells, threads=os.cpu_count() or 1)
         return time.perf_counter() - t0
 
     try:

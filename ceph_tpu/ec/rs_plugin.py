@@ -62,6 +62,27 @@ def _decode_matrix_cached(
     return gf8.decode_matrix(_matrix_for(technique, k, m), k, present)
 
 
+@functools.lru_cache(maxsize=4096)
+def _want_matrix_cached(
+    technique: str, k: int, m: int,
+    present: tuple[int, ...], want: tuple[int, ...],
+) -> np.ndarray:
+    """Recovery matrix producing exactly the ``want`` rows (generator
+    indices; parity rows allowed) from k survivors in ``present`` order.
+    A wanted parity row j is coding_matrix[j-k] @ recovery_matrix — the
+    composition folds host-side (tiny k x k work), so rebuilding a lost
+    parity chunk is STILL one device matmul (the bench fused_stacked
+    trick: stack the matrices, not the dispatches)."""
+    rmat = _decode_matrix_cached(technique, k, m, present)
+    mat = _matrix_for(technique, k, m)
+    rows = [
+        rmat[w] if w < k
+        else gf8.gf_matmul(mat[w - k : w - k + 1], rmat)[0]
+        for w in want
+    ]
+    return np.ascontiguousarray(np.stack(rows))
+
+
 LARGEST_VECTOR_WORDSIZE = 16  # reference ErasureCodeJerasure.cc:30
 
 
@@ -167,15 +188,36 @@ class RSCodec(ErasureCode):
 
         return rs.encode(self.matrix, data)
 
-    def decode_batch(self, present: tuple[int, ...], surviving):
-        """(B, k, W) uint32 survivors (rows in `present` order) ->
-        (B, k, W) uint32 recovered data."""
+    def encode_crc_batch(self, data, cell_bytes: int):
+        """(B, k, W) uint32 -> (parity (B, m, W) uint32, crcs (B, k+m)
+        uint32): parity AND the per-cell CRC32Cs of data+parity in ONE
+        fused device dispatch — the write path's hash_info comes back
+        with the parity instead of a second host pass over the cells."""
         from ..ops import rs
 
-        rmat = _decode_matrix_cached(
-            self.technique, self.k, self.m, tuple(present)
-        )
+        return rs.jit_encode_with_crcs(self.matrix, cell_bytes)(data)
+
+    def decode_batch(self, present: tuple[int, ...], surviving,
+                     want: tuple[int, ...] | None = None):
+        """(B, k, W) uint32 survivors (rows in `present` order) ->
+        (B, k, W) uint32 recovered data, or — with ``want`` — exactly
+        those generator rows (parity rows fold into the matrix)."""
+        from ..ops import rs
+
+        if want is None:
+            rmat = _decode_matrix_cached(
+                self.technique, self.k, self.m, tuple(present)
+            )
+        else:
+            rmat = self.decode_matrix_for(present, want)
         return rs.jit_gf_matmul(rmat)(surviving)
+
+    def decode_matrix_for(self, present, want) -> np.ndarray:
+        """Host recovery matrix mapping survivors (``present`` order,
+        generator indices) to the ``want`` generator rows — shared by
+        the device decode path and the host engine's batched matmul."""
+        return _want_matrix_cached(self.technique, self.k, self.m,
+                                   tuple(present), tuple(want))
 
 
 register("rs_tpu", RSCodec)

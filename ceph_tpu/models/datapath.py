@@ -28,8 +28,6 @@ import numpy as np
 from ..ops import crc32c as crc_ops
 from ..ops import gf8, rs
 
-CRC_SEED = 0xFFFFFFFF
-
 
 @dataclass(frozen=True)
 class ECParams:
@@ -52,9 +50,9 @@ class ECParams:
 
 
 def _chunk_crcs(chunks: jax.Array, chunk_bytes: int) -> jax.Array:
-    """Per-chunk CRC32C over the last (word) axis; W must be 2^n."""
-    seed_shifted = crc_ops.zeros_shift(CRC_SEED, chunk_bytes)
-    return crc_ops.crc32c_words_device(chunks, seed_shifted)
+    """Per-chunk CRC32C over the last (word) axis (front-padded to 2^n
+    words inside the trace when W isn't one already)."""
+    return crc_ops.crc32c_cells_device(chunks, chunk_bytes)
 
 
 def write_step(params: ECParams, data: jax.Array):

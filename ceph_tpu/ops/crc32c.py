@@ -34,6 +34,7 @@ import jax.numpy as jnp
 import numpy as np
 
 CRC_POLY_REFLECTED = 0x82F63B78
+CRC_SEED = 0xFFFFFFFF  # the standard seed every checksum in the tree uses
 
 
 @functools.lru_cache(maxsize=None)
@@ -315,3 +316,17 @@ def crc32c_words_device(words: jax.Array, seed_shifted: int) -> jax.Array:
     """Device-side entry for fused pipelines: pre-packed words + pre-shifted
     seed constant (zeros_shift(seed, L)). Stays on device, jit-safe."""
     return _crc0_words(words) ^ jnp.uint32(seed_shifted)
+
+
+def crc32c_cells_device(cells: jax.Array, cell_bytes: int) -> jax.Array:
+    """Per-cell CRC32C (standard seed) of (..., W) uint32 cells with ANY
+    word count, jit-safe: front-pads with zero words to the next power
+    of two inside the trace (leading zeros are CRC-neutral from state
+    0) before the tree fold. ``cell_bytes`` must be the static true
+    cell length (4 * W) — it folds the seed host-side at trace time."""
+    w = cells.shape[-1]
+    wp = 1 << max(0, (w - 1)).bit_length()
+    if wp != w:
+        pad = [(0, 0)] * (cells.ndim - 1) + [(wp - w, 0)]
+        cells = jnp.pad(cells, pad)
+    return crc32c_words_device(cells, zeros_shift(CRC_SEED, cell_bytes))

@@ -198,7 +198,18 @@ SCHEMA = Schema([
            desc="concurrent recoveries/backfills per OSD, local and "
                 "remote slots alike (AsyncReserver role)", min=1),
     Option("osd_ec_batch_window", "secs", 0.0,
-           desc="extra wait to accrete EC stripes into one device batch"),
+           desc="EC batch coalescing deadline: stripes accrete across "
+                "reactor ticks up to this long before dispatch (0 = "
+                "flush every tick; NIC-interrupt-coalescing role)"),
+    Option("osd_ec_batch_target_stripes", "int", 64, min=0,
+           desc="EC batch size target: a bucket reaching this many "
+                "queued stripes flushes immediately, ahead of the "
+                "window deadline (0 = no size trigger)"),
+    Option("osd_op_concurrency", "int", 16, min=1,
+           desc="client/recovery ops dispatched concurrently from the "
+                "mClock queue; >1 lets EC stripes from different ops "
+                "coalesce into one device batch (per-PG write ordering "
+                "is preserved by the PG lock)"),
     Option("store_kind", "str", "memstore",
            enum=("memstore", "walstore"), runtime=False,
            desc="ObjectStore backend for OSD-lite daemons"),

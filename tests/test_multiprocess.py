@@ -384,14 +384,25 @@ def test_multiprocess_mon_command(tmp_path):
     lands on a peon) and mutates the committed map."""
     import json
 
+    import time
+
     async def t():
         c = await make(tmp_path, n_mons=3)
         try:
-            rc, outs, outb = await c.client.mon_command(["status"])
-            assert rc == 0
-            st = json.loads(outb)
-            assert st["osdmap"]["num_up_osds"] == 3
-            assert st["monmap"]["num_mons"] == 3
+            # poll the status digest with a deadline: under full-suite
+            # load a mon can answer before every peer joined the
+            # quorum / every OSD booted, so a single read races
+            # (num_mons came back 2-of-3 in the wild)
+            deadline = time.monotonic() + 30
+            while True:
+                rc, outs, outb = await c.client.mon_command(["status"])
+                assert rc == 0
+                st = json.loads(outb)
+                if (st["osdmap"]["num_up_osds"] == 3
+                        and st["monmap"]["num_mons"] == 3):
+                    break
+                assert time.monotonic() < deadline, st
+                await asyncio.sleep(0.25)
             rc, _, outb = await c.client.mon_command(["osd", "tree"])
             assert rc == 0
             rows = [n for n in json.loads(outb) if n["type"] == "osd"]
@@ -406,10 +417,16 @@ def test_multiprocess_mon_command(tmp_path):
                     break
                 await asyncio.sleep(0.1)
             assert c.client.osdmap.osds[2].weight == 0x8000
-            # quorum_status names a leader all ranks agree on
-            rc, _, outb = await c.client.mon_command(["quorum_status"])
-            q = json.loads(outb)
-            assert len(q["quorum"]) == 3
+            # quorum_status names a leader all ranks agree on (same
+            # deadline poll: membership may still be converging)
+            deadline = time.monotonic() + 30
+            while True:
+                rc, _, outb = await c.client.mon_command(["quorum_status"])
+                q = json.loads(outb)
+                if len(q["quorum"]) == 3:
+                    break
+                assert time.monotonic() < deadline, q
+                await asyncio.sleep(0.25)
         finally:
             await c.stop()
 

@@ -123,6 +123,65 @@ def test_trace_shape_metadata_access_is_clean():
     assert out == []
 
 
+def test_trace_reactor_readback_fires():
+    """The regression the fused EC pipeline must never reintroduce: a
+    blocking np.asarray readback of a batched device dispatch placed on
+    the reactor thread (an async def) — it stalls the whole daemon for
+    the transfer+execution round trip."""
+    out = lint(
+        """
+        import numpy as np
+
+        class PG:
+            async def write_stripes(self, codec, batch):
+                return np.asarray(codec.encode_batch(batch))
+
+            async def rebuild(self, codec, present, surv):
+                return np.asarray(codec.decode_batch(present, surv))
+        """,
+        "ceph_tpu/cluster/fixture.py", only=["trace-safety"])
+    assert len(out) == 2
+    assert all("reactor thread" in m for m in msgs(out))
+    assert {f.symbol for f in out} == {"PG.write_stripes", "PG.rebuild"}
+
+
+def test_trace_reactor_readback_in_executor_is_clean():
+    # the idiomatic shape (cluster/ecbatch.py): dispatch + readback in
+    # a SYNC helper that the async side runs on an executor worker
+    out = lint(
+        """
+        import numpy as np
+
+        class Batcher:
+            @staticmethod
+            def _encode_sync(codec, batch):
+                return np.asarray(codec.encode_batch(batch))
+
+            async def encode(self, loop, codec, batch):
+                return await loop.run_in_executor(
+                    None, self._encode_sync, codec, batch)
+        """,
+        "ceph_tpu/cluster/fixture.py", only=["trace-safety"])
+    assert out == []
+
+
+def test_trace_reactor_readback_skips_nested_sync_def():
+    # a sync closure defined inside an async fn runs wherever it is
+    # called (e.g. handed to an executor) — not on the reactor per se
+    out = lint(
+        """
+        import numpy as np
+
+        class Batcher:
+            async def encode(self, loop, codec, batch):
+                def work():
+                    return np.asarray(codec.encode_batch(batch))
+                return await loop.run_in_executor(None, work)
+        """,
+        "ceph_tpu/cluster/fixture.py", only=["trace-safety"])
+    assert out == []
+
+
 def test_trace_clean_kernel_is_clean():
     # the idiom of ops/crc32c.py: shape access, astype, while loop
     out = lint(
