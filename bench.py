@@ -574,15 +574,26 @@ def config6_rados_bench(latency: float) -> dict:
         dt_r = time.perf_counter() - t0
 
         batches = stripes = failures = 0
+        fail_injected = fail_dispatch = 0
+        crc_errs = stale_excl = 0
         dec_batches = dec_stripes = 0
         qwait_sum = qwait_n = 0.0
         flush: dict[str, int] = {}
+        faults: dict[str, int] = {}
         for osd in c.osds:
             if osd is None:
                 continue
             d = osd.perf.dump()
             batches += int(d.get("ec_batches", 0))
             failures += int(d.get("ec_batch_failures", 0))
+            fail_injected += int(d.get("ec_batch_failures_injected", 0))
+            fail_dispatch += int(d.get("ec_batch_failures_dispatch", 0))
+            crc_errs += int(d.get("ec_read_crc_err", 0))
+            stale_excl += int(d.get("ec_read_stale_shard", 0))
+            for key, val in d.items():
+                if str(key).startswith("faults_injected_"):
+                    site = str(key)[len("faults_injected_"):]
+                    faults[site] = faults.get(site, 0) + int(val)
             dec_batches += int(d.get("ec_decode_batches", 0))
             h = d.get("ec_batch_stripes", {})
             if isinstance(h, dict):
@@ -623,7 +634,16 @@ def config6_rados_bench(latency: float) -> dict:
             # the flush-reason breakdown plus mean queue wait tells
             # whether occupancy is window-bound, size-bound, or the
             # mClock fast path is draining sparse cohorts
+            # robustness ledger (PR 3): a clean bench run must show
+            # zero failures/CRC errors/injections — nonzero here means
+            # the measured number rode a degraded path
             "ec_batch_failures": failures,
+            "ec_batch_failures_injected": fail_injected,
+            "ec_batch_failures_dispatch": fail_dispatch,
+            "ec_read_crc_err": crc_errs,
+            "ec_read_stale_shard": stale_excl,
+            "client_op_retries": c.client.op_retries,
+            "faults_injected": faults,
             "ec_decode_batches": dec_batches,
             "ec_decode_stripes": dec_stripes,
             "flush_reasons": flush,

@@ -11,6 +11,13 @@ Two inverse hazards around ``self._lock``-style mutexes:
   whole event loop with the lock pinned, the mon/OSD heartbeat-death
   pattern.
 
+Plus one fault-plane hazard (same family): a fault-injection hook is
+AWAITED while holding a lock — injected pauses (FaultInjector.pause,
+utils/fault.py) exist to stall ONE op, but under a PG lock they stall
+every op of the PG with the lock pinned, turning a latency fault into
+a livelock the thrasher then misattributes. Sync ``fault.hit()`` calls
+under a lock are fine (one dict lookup); only awaits fire.
+
 ``__init__`` (and other underscore-free constructors) are exempt from
 the first check: construction happens-before sharing.
 """
@@ -168,6 +175,13 @@ class LockDisciplineRule(Rule):
         for c in ast.iter_child_nodes(node):
             yield from self._walk_assigns(c, info, in_lock)
 
+    @staticmethod
+    def _is_fault_hook(name: str) -> bool:
+        """Dotted path of a fault-injection hook: any segment named
+        ``fault``/``faults`` (self.osd.fault.pause, plane.faults...)."""
+        return any(seg in ("fault", "faults")
+                   for seg in name.split("."))
+
     def _blocking_in_lock(self, node: ast.AST, info: _ClassInfo,
                           path: str, symbol: str,
                           held: bool = False) -> Iterator[Finding]:
@@ -180,6 +194,15 @@ class LockDisciplineRule(Rule):
                     self.id, path, node.lineno, symbol,
                     f"blocking call `{name}` while holding a lock "
                     "stalls the event loop with the lock pinned")
+        if (held and isinstance(node, ast.Await)
+                and isinstance(node.value, ast.Call)):
+            name = call_name(node.value.func)
+            if self._is_fault_hook(name):
+                yield Finding(
+                    self.id, path, node.lineno, symbol,
+                    f"fault-injection hook `{name}` awaited while "
+                    "holding a lock: an injected pause must stall one "
+                    "op, not pin the lock for the whole PG")
         for c in ast.iter_child_nodes(node):
             yield from self._blocking_in_lock(c, info, path, symbol,
                                               held)

@@ -11,10 +11,12 @@ from __future__ import annotations
 
 import asyncio
 import functools
+import random
 from dataclasses import dataclass, field
 
 from ..placement import encoding as menc
 from ..placement.osdmap import PlacementMemo, Pool
+from ..utils import config as cfg
 from ..utils import denc, trace
 from . import messages as M
 
@@ -77,11 +79,18 @@ class _InFlight:
 
 class RadosClient:
     def __init__(self, bus, name: str = "client.0",
-                 op_timeout: float = 10.0):
+                 op_timeout: float = 10.0,
+                 conf: cfg.ConfigProxy | None = None):
         self.bus = bus
         self.name = name
         self.osdmap = None
         self.op_timeout = op_timeout
+        self.conf = conf if conf is not None else cfg.proxy()
+        #: total resend decisions (ESTALE/EAGAIN bounces + tick
+        #: resends) — the client_op_retries counter thrash verdicts and
+        #: bench config 6 report
+        self.op_retries = 0
+        self._backoff_rng = random.Random()
         # tid doubles as the reqid the OSD's write dedup is keyed on
         # (src, tid); the reference scopes reqids by an entity NONCE so
         # a restarted client can never collide with its predecessor's
@@ -198,6 +207,16 @@ class RadosClient:
                     self._send_op(op)
                 )
 
+    def _backoff(self, attempts: int) -> float:
+        """Bounded exponential backoff with jitter for the resend
+        loops (the reference osd_backoff / Objecter retry discipline):
+        base * 2^attempts capped at the max, scaled by uniform
+        [0.5, 1.0) so a thundering herd of bounced clients de-phases."""
+        base = float(self.conf["client_backoff_base"])
+        cap = float(self.conf["client_backoff_max"])
+        d = min(cap, base * (1 << min(max(attempts, 0), 16)))
+        return d * (0.5 + 0.5 * self._backoff_rng.random())
+
     async def _handle_reply(self, msg: M.MOSDOpReply) -> None:
         op = self._ops.get(msg.tid)
         if op is None:
@@ -206,6 +225,7 @@ class RadosClient:
             # refresh the map, recalc, resend (with a retry cap)
             op.last_result = msg.result
             op.attempts += 1
+            self.op_retries += 1
             if op.attempts > 20:
                 del self._ops[msg.tid]
                 if not op.fut.done():
@@ -221,7 +241,7 @@ class RadosClient:
                 )
             except Exception:
                 pass  # keep resending on whatever map we have
-            await asyncio.sleep(0.05 * min(op.attempts, 10))
+            await asyncio.sleep(self._backoff(op.attempts - 1))
             if op.msg.oid:
                 # re-hash: a pg_num change may have moved the object
                 # to a different (split child) PG
@@ -297,10 +317,24 @@ class RadosClient:
             # tick-resend while waiting (Objecter op-tracking role): a
             # message written into a half-dead TCP connection (peer
             # kill -9, RST not yet seen) is lost silently — the resend
-            # re-dials a fresh connection to the revived daemon
+            # re-dials a fresh connection to the revived daemon. The
+            # tick grows exponentially with jitter (bounded by
+            # client_backoff_max): under a partition every waiting
+            # client would otherwise hammer the dead primary in phase.
             loop = asyncio.get_running_loop()
             deadline = loop.time() + self.op_timeout
-            tick = max(self.op_timeout / 4, 0.5)
+            # first tick stays lazy (a healthy op slower than the tick
+            # would be re-sent for nothing — dedup'd, but only after a
+            # full re-delivery); later ticks grow toward the cap. The
+            # configured ceiling really is the hard cap: op_timeout
+            # scales the lazy floor only BELOW it, so a long-deadline
+            # client (the thrasher sets op_timeout to the whole
+            # thrash+settle horizon) still re-probes a healed partition
+            # within client_backoff_max, not op_timeout/8
+            cap = float(self.conf["client_backoff_max"])
+            floor = max(0.5, min(self.op_timeout / 8, cap))
+            ceil = max(cap, floor)
+            resends = 0
             while True:
                 left = deadline - loop.time()
                 if left <= 0:
@@ -314,6 +348,11 @@ class RadosClient:
                             f"{op.last_result})")
                     raise asyncio.TimeoutError(
                         f"op {tid} ({verb}) timed out")
+                # upward jitter de-phases the herd without dipping
+                # below the lazy floor; the configured ceiling is a
+                # hard cap, jitter included
+                tick = min(ceil, floor * (1 << min(resends, 16))
+                           * (1.0 + 0.5 * self._backoff_rng.random()))
                 try:
                     # shield: a tick timeout must NOT cancel the
                     # pending future (the reply may still arrive)
@@ -321,6 +360,8 @@ class RadosClient:
                         asyncio.shield(op.fut), min(tick, left))
                     break
                 except asyncio.TimeoutError:
+                    resends += 1
+                    self.op_retries += 1
                     op.target = self._calc_target(op.msg.pgid)
                     if op.target >= 0:
                         op.msg.epoch = self.osdmap.epoch

@@ -40,6 +40,7 @@ import os
 import numpy as np
 
 from .. import native
+from ..utils.fault import InjectedError
 
 _FAILED = object()
 
@@ -74,7 +75,8 @@ class ECBatcher:
     probe — ec/engine.py). Dispatch + readback run in a worker thread
     so the reactor keeps serving ops while batches are in flight."""
 
-    def __init__(self, perf=None, conf=None, idle_probe=None) -> None:
+    def __init__(self, perf=None, conf=None, idle_probe=None,
+                 fault=None) -> None:
         #: bucket key -> [(codec, cells, fut, t_enqueue)]
         self._pending: dict[tuple, list] = {}
         #: bucket key -> (reason, TimerHandle) for an armed flush timer
@@ -91,6 +93,9 @@ class ECBatcher:
         #: () -> bool: True when the op scheduler has nothing queued
         #: that could contribute more stripes (mClock-aware fast flush)
         self.idle_probe = idle_probe
+        #: optional FaultInjector (the owning OSD's): site "ec_batch"
+        #: fails a dispatch, exercising the fail-closed isolation path
+        self.fault = fault
 
     @staticmethod
     def declare_counters(perf) -> None:
@@ -100,6 +105,15 @@ class ECBatcher:
         perf.add_histogram("ec_batch_stripes", "stripes per EC encode batch")
         perf.add_u64_counter("ec_batch_failures",
                              "EC batch dispatches that failed")
+        perf.add_u64_counter("ec_batch_failures_injected",
+                             "op stripe-groups failed by an INJECTED "
+                             "dispatch error (fault site ec_batch)")
+        perf.add_u64_counter("ec_batch_failures_dispatch",
+                             "op stripe-groups failed by an organic "
+                             "device/executor dispatch error")
+        perf.add_u64_counter("ec_batch_isolated",
+                             "stripe-groups that recovered via "
+                             "per-item isolation after a batch failure")
         perf.add_u64_counter("ec_decode_batches",
                              "batched EC decode dispatches")
         perf.add_histogram("ec_decode_stripes",
@@ -264,6 +278,59 @@ class ECBatcher:
 
     # ------------------------------------------------------- execution
 
+    async def _dispatch_once(self, loop, key: tuple, codec,
+                             cells: np.ndarray):
+        """One executor dispatch of a cell batch (shared by the normal
+        batched path and the per-item isolation retries); the armed
+        ``ec_batch`` fault site fails it with an InjectedError."""
+        if self.fault is not None and self.fault.hit(
+                "ec_batch", kind=key[0], stripes=len(cells)):
+            raise InjectedError("injected EC batch dispatch failure")
+        if key[0] == "enc":
+            return await loop.run_in_executor(
+                None, self._encode_sync, codec, cells)
+        return await loop.run_in_executor(
+            None, self._decode_sync, codec, key[3], key[4], cells)
+
+    def _count_cause(self, exc: BaseException) -> None:
+        if self.perf is not None:
+            self.perf.inc("ec_batch_failures_injected"
+                          if isinstance(exc, InjectedError)
+                          else "ec_batch_failures_dispatch")
+
+    async def _fail_closed(self, loop, key: tuple, items: list,
+                           batch_exc: BaseException) -> None:
+        """Fail closed: a poisoned batch must fail ONLY the stripes of
+        the ops that still fail alone. Each submission group is retried
+        as its own dispatch, so one op's bad stripes never reject its
+        batch-mates, every waiter resolves exactly once, and the
+        coalescing queue keeps flowing (callers never hold a PG lock
+        across batcher awaits, so no lock can leak either way)."""
+        kind = key[0]
+        for codec, cells, fut, _t0 in items:
+            if fut.done():
+                continue
+            if len(items) == 1:
+                # alone in the batch: the batch failure IS this op's
+                self._count_cause(batch_exc)
+                fut.set_result(_FAILED)
+                continue
+            try:
+                out = await self._dispatch_once(loop, key, codec, cells)
+            except Exception as e:
+                self._count_cause(e)
+                fut.set_result(_FAILED)
+                continue
+            if self.perf is not None:
+                self.perf.inc("ec_batch_isolated")
+                if kind == "enc":
+                    self.perf.inc("ec_batches")
+                    self.perf.observe("ec_batch_stripes", len(cells))
+                else:
+                    self.perf.inc("ec_decode_batches")
+                    self.perf.observe("ec_decode_stripes", len(cells))
+            fut.set_result(out)
+
     async def _run(self, key: tuple, items: list) -> None:
         loop = asyncio.get_running_loop()
         if self.perf is not None:
@@ -275,25 +342,31 @@ class ECBatcher:
         codec = items[0][0]
         cells = (items[0][1] if len(items) == 1
                  else np.concatenate([c for _, c, _, _ in items]))
+        released = False
         try:
-            if kind == "enc":
-                out = await loop.run_in_executor(
-                    None, self._encode_sync, codec, cells)
-            else:
-                out = await loop.run_in_executor(
-                    None, self._decode_sync, codec, key[3], key[4], cells)
-        except Exception:
-            # failed dispatches are NOT throughput: count the failure,
-            # never the batch, and reject every waiter exactly once
+            out = await self._dispatch_once(loop, key, codec, cells)
+        except Exception as e:
+            # failed dispatches are NOT throughput: count the failure
+            # (split by cause per finally-failed group), never the
+            # batch, and resolve every waiter exactly once — innocent
+            # batch-mates recover via per-item isolation. Release the
+            # bucket FIRST: fresh stripes must keep dispatching while
+            # the serial isolation retries grind through the wreck —
+            # and release exactly ONCE: by the time _fail_closed
+            # returns, a fresh batch for this key may be in flight,
+            # and discarding its marker would let a third _run launch
+            # concurrently.
             if self.perf is not None:
                 self.perf.inc("ec_batch_failures")
-            for _, _, fut, _ in items:
-                if not fut.done():
-                    fut.set_result(_FAILED)
-            return
-        finally:
+            released = True
             self._inflight.discard(key)
             self._poke(key, drain=True)
+            await self._fail_closed(loop, key, items, e)
+            return
+        finally:
+            if not released:
+                self._inflight.discard(key)
+                self._poke(key, drain=True)
         # perf accounting strictly after success
         if self.perf is not None:
             if kind == "enc":

@@ -1,0 +1,596 @@
+"""Fault plane: seeded, deterministic fault injection for the cluster.
+
+The teuthology/OSDThrasher discipline (qa/tasks/ceph_manager.py:202)
+brought in-process: one ``FaultPlane`` per cluster threads faults
+through the three layers where real clusters break —
+
+- **messenger** (``NetFaultPolicy``): per-peer-pair drop / delay /
+  duplicate / reorder and full partitions, honored by both LocalBus and
+  TcpMessenger (msg/messenger.py). This replaces the old ad-hoc
+  ``LocalBus.blackholes`` set (kept as a compatibility view over the
+  policy) with the ms_inject_socket_failures / ms_inject_delay_* role.
+- **object store / device** (per-OSD ``FaultInjector`` arms, utils/
+  fault.py): injected EIO, bit-flips on read (so hinfo CRC verification
+  is actually exercised), torn writes, and EC batch dispatch failures.
+  Specs registered on the plane re-arm automatically on OSD revive.
+- **daemon lifecycle** (``Thrasher``): randomized kill/revive/flap and
+  partition schedules orchestrated through ``vstart.TestCluster``
+  (plus mon failover when the cluster runs a Paxos quorum).
+
+Everything derives from ONE seed: the thrash schedule is generated
+upfront as a pure function of (seed, duration, topology) — same seed,
+same schedule, same per-link fault draws — which is what makes a
+thrash failure replayable (the FaultInjector role of
+src/common/fault_injector.h:66, scaled up to a plan).
+
+The ``Thrasher`` runs its schedule under a live write workload with a
+client-side oracle (``OracleWorkload``) and then demands convergence:
+every PG active, every pg_temp pin cleared, a deep-scrub round finding
+zero inconsistencies after one repair pass, and every object reading
+byte-equal to the oracle.
+"""
+from __future__ import annotations
+
+import asyncio
+import random
+from dataclasses import dataclass, field
+
+from ..utils.fault import FaultInjector
+
+#: fault sites the store/device layer exposes (arm via
+#: FaultPlane.store_fault); pg.py / ecbatch.py call fault.hit() here
+STORE_SITES = (
+    "ec_local_read",    # primary's own shard read -> injected EIO
+    "ec_sub_read",      # shard-side sub-read -> injected EIO
+    "ec_read_bitflip",  # flip a bit in the chunk BEFORE hinfo verify
+    "torn_write",       # persist only a prefix of a shard transaction
+    "ec_batch",         # EC batch device dispatch failure
+    "op_dispatch_delay",  # stall one client op before it runs
+)
+
+
+def flip_bit(chunk: bytes) -> bytes:
+    """One-bit rot in the middle of a buffer (enough to break any CRC;
+    deterministic so replays corrupt identically)."""
+    if not chunk:
+        return chunk
+    buf = bytearray(chunk)
+    buf[len(buf) // 2] ^= 0x01
+    return bytes(buf)
+
+
+@dataclass
+class LinkFault:
+    """Per-peer-pair fault mix (the ms_inject_* option set)."""
+
+    drop: float = 0.0      # P(message silently dropped)
+    dup: float = 0.0       # P(message delivered twice)
+    delay: float = 0.0     # fixed added latency (seconds)
+    jitter: float = 0.0    # + uniform[0, jitter) extra latency
+    reorder: float = 0.0   # P(message additionally held back ~2x delay)
+
+
+class NetFaultPolicy:
+    """Decides the fate of every (src, dst) send. Honored by LocalBus
+    (all traffic) and TcpMessenger (its own outgoing sends).
+
+    ``plan(src, dst)`` returns None to drop the message silently, else
+    a list of delivery delays in seconds — one entry per copy delivered
+    (length 2 = duplicate). All randomness comes from the policy's own
+    seeded RNG, and the RNG is consulted ONLY when a matching LinkFault
+    is installed, so unfaulted traffic never perturbs the stream.
+    """
+
+    def __init__(self, rng: random.Random | None = None):
+        self.rng = rng if rng is not None else random.Random(0)
+        #: entity-level silent drop (the legacy blackhole verb —
+        #: LocalBus.blackholes is a view of this set)
+        self.blackholes: set[str] = set()
+        #: (src, dst) -> LinkFault; "*" matches any entity
+        self._links: dict[tuple[str, str], LinkFault] = {}
+        #: bidirectional cuts: (group_a, group_b); "*" in a group
+        #: matches every entity not named in the other group
+        self._partitions: list[tuple[frozenset, frozenset]] = []
+        self.counters: dict[str, int] = {}
+
+    # ------------------------------------------------------ installers
+
+    def set_link(self, src: str, dst: str, *, drop: float = 0.0,
+                 dup: float = 0.0, delay: float = 0.0,
+                 jitter: float = 0.0, reorder: float = 0.0,
+                 symmetric: bool = False) -> None:
+        """Install a fault mix on src->dst ("*" wildcards either end);
+        ``symmetric`` installs the mirror link too."""
+        self._links[(src, dst)] = LinkFault(drop, dup, delay, jitter,
+                                            reorder)
+        if symmetric and (dst, src) != (src, dst):
+            self._links[(dst, src)] = LinkFault(drop, dup, delay,
+                                                jitter, reorder)
+
+    def clear_link(self, src: str, dst: str,
+                   symmetric: bool = False) -> None:
+        self._links.pop((src, dst), None)
+        if symmetric:
+            self._links.pop((dst, src), None)
+
+    def clear_links(self) -> None:
+        self._links.clear()
+
+    def partition(self, a, b) -> None:
+        """Full bidirectional cut between entity groups a and b.
+        ``partition({"osd.3"}, {"*"})`` isolates osd.3 from everyone."""
+        self._partitions.append((frozenset(a), frozenset(b)))
+
+    def heal(self) -> None:
+        """Remove every partition (the thrasher's heal verb); link
+        faults and blackholes are cleared separately."""
+        self._partitions.clear()
+
+    def clear(self) -> None:
+        self.heal()
+        self.clear_links()
+        self.blackholes.clear()
+
+    @property
+    def partitions(self) -> list[tuple[frozenset, frozenset]]:
+        return list(self._partitions)
+
+    # -------------------------------------------------------- decision
+
+    def _in_group(self, who: str, group: frozenset,
+                  other: frozenset) -> bool:
+        return who in group or ("*" in group and who not in other)
+
+    def partitioned(self, src: str, dst: str) -> bool:
+        for a, b in self._partitions:
+            if ((self._in_group(src, a, b) and self._in_group(dst, b, a))
+                    or (self._in_group(src, b, a)
+                        and self._in_group(dst, a, b))):
+                return True
+        return False
+
+    def _link_for(self, src: str, dst: str) -> LinkFault | None:
+        for key in ((src, dst), (src, "*"), ("*", dst), ("*", "*")):
+            f = self._links.get(key)
+            if f is not None:
+                return f
+        return None
+
+    def _count(self, what: str) -> None:
+        self.counters[what] = self.counters.get(what, 0) + 1
+
+    def plan(self, src: str, dst: str) -> list[float] | None:
+        """Delivery plan for one message: None = silent drop; else the
+        delays (seconds) of each copy to deliver."""
+        if src in self.blackholes or dst in self.blackholes:
+            self._count("blackhole")
+            return None
+        if self.partitioned(src, dst):
+            self._count("partition_drop")
+            return None
+        f = self._link_for(src, dst)
+        if f is None:
+            return [0.0]
+        r = self.rng
+        if f.drop and r.random() < f.drop:
+            self._count("drop")
+            return None
+        d = f.delay
+        if f.jitter:
+            d += r.random() * f.jitter
+        if f.reorder and r.random() < f.reorder:
+            # held back long enough to land behind later sends
+            d += 2.0 * (f.delay or 0.005) + r.random() * 0.005
+            self._count("reorder")
+        if d > 0:
+            self._count("delay")
+        out = [d]
+        if f.dup and r.random() < f.dup:
+            out.append(d + 0.001)
+            self._count("dup")
+        return out
+
+
+class FaultPlane:
+    """One seeded fault authority per cluster: the messenger policy,
+    the per-OSD store/device fault specs (re-armed on revive), and the
+    aggregate injection counters the thrash verdict reports."""
+
+    def __init__(self, seed: int = 0):
+        self.seed = seed
+        #: derived, independent streams so arming one layer never
+        #: shifts another layer's draws
+        self.net = NetFaultPolicy(rng=random.Random(seed ^ 0x9E3779B9))
+        self._store_rng = random.Random(seed ^ 0x51ED2705)
+        #: site -> (kwargs for FaultInjector.arm)
+        self._store_specs: dict[str, dict] = {}
+        #: every injector ever attached (revives append; history kept
+        #: so fired counts survive a kill)
+        self._injectors: list[tuple[int, FaultInjector]] = []
+
+    # ------------------------------------------------------ store layer
+
+    def attach_osd(self, osd) -> None:
+        """Wire a (re)started OSD into the plane: registered store
+        fault specs arm on its injector, and injections feed its
+        faults_injected_* perf counters."""
+        self._injectors.append((osd.id, osd.fault))
+        for site, spec in self._store_specs.items():
+            osd.fault.arm(site, rng=self._store_rng, **spec)
+
+    def store_fault(self, site: str, count: int = -1, p: float = 1.0,
+                    delay: float = 0.0, **match) -> None:
+        """Arm a store/device fault site on every attached OSD (and
+        every OSD revived later). Probability draws come from the
+        plane's seeded store RNG. Re-arming a site REPLACES the prior
+        spec on live injectors — stacking arms would make live and
+        revived OSDs fire at different rates."""
+        spec = dict(count=count, p=p, delay=delay, **match)
+        self._store_specs[site] = spec
+        seen: set[int] = set()
+        for osd_id, inj in reversed(self._injectors):
+            if osd_id in seen:
+                continue  # only the newest incarnation is live
+            seen.add(osd_id)
+            inj.disarm(site)
+            inj.arm(site, rng=self._store_rng, **spec)
+
+    def clear_store_faults(self) -> None:
+        sites = list(self._store_specs)
+        self._store_specs.clear()
+        for _osd_id, inj in self._injectors:
+            for site in sites:
+                inj.disarm(site)
+
+    # ------------------------------------------------------- accounting
+
+    def injected(self) -> dict[str, int]:
+        """Aggregate injection counts across layers (net decisions plus
+        every OSD incarnation's fired sites)."""
+        out = dict(self.net.counters)
+        for _osd_id, inj in self._injectors:
+            for site, n in inj.fired_all().items():
+                out[site] = out.get(site, 0) + n
+        return out
+
+
+# ===================================================== thrash driver ==
+
+
+@dataclass(frozen=True)
+class ThrashEvent:
+    t: float      # seconds from thrash start
+    kind: str     # kill | revive | partition | heal | mon_flap
+    target: int = -1  # osd id (kill/revive/partition); -1 = n/a
+
+
+def build_schedule(seed: int, duration: float, n_osds: int,
+                   max_unavail: int = 1, gap: tuple[float, float] =
+                   (0.4, 1.2), partitions: bool = True,
+                   mon_flaps: bool = False) -> list[ThrashEvent]:
+    """Deterministic thrash schedule: a pure function of its arguments
+    (same seed => same schedule, the replayability contract). The
+    generator tracks the dead/partitioned set so it never schedules
+    more than ``max_unavail`` simultaneously-unavailable OSDs — an EC
+    pool keeps >= k shards reachable throughout."""
+    rng = random.Random(seed)
+    # an all-dead cluster has nothing left to thrash (and nothing to
+    # converge back): always keep at least one OSD reachable
+    max_unavail = min(max_unavail, max(0, n_osds - 1))
+    events: list[ThrashEvent] = []
+    dead: set[int] = set()
+    cut: int = -1  # osd currently partitioned, -1 = none
+    t = 0.0
+    while True:
+        t += rng.uniform(*gap)
+        if t >= duration:
+            break
+        choices: list[str] = []
+        unavail = len(dead) + (1 if cut >= 0 else 0)
+        if unavail < max_unavail:
+            choices.append("kill")
+            if partitions and cut < 0:
+                choices.append("partition")
+        if dead:
+            choices += ["revive"] * 2  # bias toward healing
+        if cut >= 0:
+            choices += ["heal"] * 2
+        if mon_flaps:
+            choices.append("mon_flap")
+        if not choices:
+            continue
+        kind = rng.choice(choices)
+        if kind == "kill":
+            victim = rng.choice(sorted(set(range(n_osds)) - dead
+                                       - {cut}))
+            dead.add(victim)
+            events.append(ThrashEvent(round(t, 3), "kill", victim))
+        elif kind == "revive":
+            victim = rng.choice(sorted(dead))
+            dead.discard(victim)
+            events.append(ThrashEvent(round(t, 3), "revive", victim))
+        elif kind == "partition":
+            cut = rng.choice(sorted(set(range(n_osds)) - dead))
+            events.append(ThrashEvent(round(t, 3), "partition", cut))
+        elif kind == "heal":
+            events.append(ThrashEvent(round(t, 3), "heal", cut))
+            cut = -1
+        elif kind == "mon_flap":
+            events.append(ThrashEvent(round(t, 3), "mon_flap"))
+    return events
+
+
+class OracleWorkload:
+    """Concurrent EC writers with a client-side oracle.
+
+    Each writer owns a disjoint set of object names and rewrites them
+    with seeded payloads, recording content in the oracle only on ack.
+    Within one object, generation N+1 is never issued before N acked,
+    and the client must be run with an op_timeout longer than the
+    thrash (tick-resends keep ONE tid per op, so the PG's reqid dedup
+    — not luck — prevents a zombie duplicate from re-applying an old
+    generation after a newer one).
+
+    ``verify()`` (run after heal) reads every object back and returns
+    the byte-mismatched names — the thrasher's ground truth.
+    """
+
+    def __init__(self, client, pool_id: int, seed: int = 0,
+                 n_objects: int = 8, size: int = 24 << 10,
+                 writers: int = 4):
+        self.client = client
+        self.pool_id = pool_id
+        self.seed = seed
+        self.size = size
+        self.names = [f"thrash-{i}" for i in range(n_objects)]
+        self.writers = max(1, min(writers, n_objects))
+        self.oracle: dict[str, bytes] = {}
+        self.gens: dict[str, int] = {n: 0 for n in self.names}
+        self.inflight: set[str] = set()
+        self.writes_acked = 0
+        self.write_retries = 0
+        self.read_checks = 0
+        self.read_mismatches: list[str] = []
+        #: one-shot mismatches that read back clean on the immediate
+        #: re-read: a race with the write pipeline, not served rot
+        self.read_transients = 0
+        self._stop = False
+        self._tasks: list[asyncio.Task] = []
+
+    def _payload(self, name: str, gen: int) -> bytes:
+        # size varies with the generation so shrinking rewrites (the
+        # stale-shard hazard's trigger shape) happen under thrash too
+        r = random.Random((self.seed << 20)
+                          ^ self.names.index(name) * 1009 ^ gen * 7919)
+        return r.randbytes(max(1024, self.size - (gen % 3) * 1024))
+
+    async def _write(self, name: str, payload: bytes) -> None:
+        self.inflight.add(name)
+        try:
+            # retry UNTIL ACKED, even across stop(): the oracle's
+            # whole contract is that generation N settles before N+1
+            # is issued and before verification — an abandoned retry
+            # would leave partial-fanout debris as the final state.
+            # stop() is called after heal+wait_clean, so the retry
+            # always lands; the cap only bounds a truly dead cluster.
+            for _attempt in range(200):
+                try:
+                    await self.client.write_full(self.pool_id, name,
+                                                 payload)
+                    break
+                except (IOError, asyncio.TimeoutError):
+                    self.write_retries += 1
+                    await asyncio.sleep(0.2)
+            else:
+                raise IOError(f"write of {name} never acked")
+            self.oracle[name] = payload
+            self.writes_acked += 1
+        finally:
+            self.inflight.discard(name)
+
+    async def _writer(self, wid: int) -> None:
+        mine = self.names[wid::self.writers]
+        while not self._stop:
+            for name in mine:
+                if self._stop:
+                    return
+                self.gens[name] += 1
+                await self._write(name,
+                                  self._payload(name, self.gens[name]))
+                await asyncio.sleep(0)
+
+    async def _reader(self) -> None:
+        """Opportunistic degraded-read checker: only objects with no
+        write in flight and a stable generation across the read are
+        byte-compared (anything else is just read-path exercise)."""
+        rng = random.Random(self.seed ^ 0xBEEF)
+        while not self._stop:
+            await asyncio.sleep(0.15)
+            acked = [n for n in self.names
+                     if n in self.oracle and n not in self.inflight]
+            if not acked:
+                continue
+            name = rng.choice(acked)
+            gen0, want = self.gens[name], self.oracle[name]
+            try:
+                got = await self.client.read(self.pool_id, name)
+            except Exception:
+                continue  # mid-fault read failure: retried by design
+            if name in self.inflight or self.gens[name] != gen0:
+                continue  # raced a rewrite: content undefined
+            self.read_checks += 1
+            if got != want:
+                # double-check before convicting: genuinely served rot
+                # or stale generations persist across an immediate
+                # re-read, while pipeline races read back clean
+                try:
+                    got2 = await self.client.read(self.pool_id, name)
+                except Exception:
+                    continue
+                if name in self.inflight or self.gens[name] != gen0:
+                    continue
+                if got2 == want:
+                    self.read_transients += 1
+                    continue
+                self.read_mismatches.append(name)
+
+    def start(self) -> None:
+        loop = asyncio.get_running_loop()
+        self._tasks = [loop.create_task(self._writer(w))
+                       for w in range(self.writers)]
+        self._tasks.append(loop.create_task(self._reader()))
+
+    async def stop(self) -> None:
+        """Stop issuing NEW generations, then wait for every in-flight
+        write to ack (run after heal: the oracle must be settled
+        before verification)."""
+        self._stop = True
+        for t in self._tasks:
+            try:
+                await t
+            except Exception:
+                t.cancel()
+        self._tasks = []
+
+    async def verify(self) -> list[str]:
+        bad: list[str] = []
+        for name, want in sorted(self.oracle.items()):
+            got = await self.client.read(self.pool_id, name)
+            if got != want:
+                bad.append(name)
+        return bad
+
+
+class Thrasher:
+    """Seeded kill/partition/bitrot schedules under a live workload,
+    then convergence: active+clean, scrub-clean, oracle byte-equal.
+
+    The cluster must have been built with this plane (TestCluster
+    wires its LocalBus and OSD injectors to it)."""
+
+    def __init__(self, cluster, pool_id: int, seed: int = 0,
+                 duration: float = 8.0, max_unavail: int = 1,
+                 bitrot_p: float = 0.0, partitions: bool = True,
+                 mon_flaps: bool = False, n_objects: int = 8,
+                 obj_size: int = 24 << 10, writers: int = 4,
+                 settle_timeout: float = 90.0):
+        self.cluster = cluster
+        self.plane: FaultPlane = cluster.faults
+        self.pool_id = pool_id
+        self.seed = seed
+        self.duration = duration
+        self.max_unavail = max_unavail
+        self.bitrot_p = bitrot_p
+        self.partitions = partitions
+        self.mon_flaps = mon_flaps and len(cluster.mons) > 1
+        self.settle_timeout = settle_timeout
+        self.workload = OracleWorkload(cluster.client, pool_id,
+                                       seed=seed, n_objects=n_objects,
+                                       size=obj_size, writers=writers)
+        self.schedule = build_schedule(
+            seed, duration, cluster.n_osds, max_unavail=max_unavail,
+            partitions=partitions, mon_flaps=self.mon_flaps)
+        self.applied: list[ThrashEvent] = []
+        self._dead_mons: list[int] = []
+
+    async def _apply(self, ev: ThrashEvent) -> None:
+        c = self.cluster
+        if ev.kind == "kill":
+            if c.osds[ev.target] is not None:
+                await c.kill_osd(ev.target)
+        elif ev.kind == "revive":
+            if c.osds[ev.target] is None:
+                await c.revive_osd(ev.target)
+        elif ev.kind == "partition":
+            self.plane.net.partition({f"osd.{ev.target}"}, {"*"})
+        elif ev.kind == "heal":
+            self.plane.net.heal()
+        elif ev.kind == "mon_flap":
+            # never break the quorum MAJORITY: killed mons stay down
+            # until the final heal, and a second flap on a 3-mon
+            # quorum would leave 1/3 — no leader, no map updates, the
+            # rest of the schedule silently exercising nothing. A
+            # flap drawn while the bound is used up revives the
+            # previous victim instead (still a failover event).
+            n = len(c.mons)
+            majority = n // 2 + 1
+            if self._dead_mons and n - len(self._dead_mons) - 1 < majority:
+                await c.revive_mon(self._dead_mons.pop(0))
+            else:
+                ranks = [r for r, m in enumerate(c.mons)
+                         if m is not None and m.is_leader()]
+                if ranks:
+                    await c.kill_mon(ranks[0])
+                    self._dead_mons.append(ranks[0])
+        self.applied.append(ev)
+
+    async def _heal_everything(self) -> None:
+        c = self.cluster
+        self.plane.net.clear()
+        self.plane.clear_store_faults()
+        for rank in self._dead_mons:
+            await c.revive_mon(rank)
+        self._dead_mons = []
+        for i, osd in enumerate(c.osds):
+            if osd is None:
+                await c.revive_osd(i)
+
+    async def run(self) -> dict:
+        """Run the schedule under workload, heal, demand convergence.
+        Returns the machine-readable verdict (tools/thrash.py emits it
+        as JSON)."""
+        c = self.cluster
+        if self.bitrot_p > 0:
+            self.plane.store_fault("ec_read_bitflip", p=self.bitrot_p)
+        self.workload.start()
+        loop = asyncio.get_running_loop()
+        t0 = loop.time()
+        for ev in self.schedule:
+            delay = t0 + ev.t - loop.time()
+            if delay > 0:
+                await asyncio.sleep(delay)
+            await self._apply(ev)
+        remaining = t0 + self.duration - loop.time()
+        if remaining > 0:
+            await asyncio.sleep(remaining)
+
+        await self._heal_everything()
+        converged = True
+        try:
+            await c.wait_clean(self.settle_timeout)
+        except asyncio.TimeoutError:
+            converged = False
+        # settle the oracle only once the cluster serves writes again
+        await self.workload.stop()
+
+        pg_num = c.mon.osdmap.pools[self.pool_id].pg_num
+        inconsistent: list = []
+        if converged:
+            # round 1 repairs whatever the thrash tore; round 2 is the
+            # verdict — deep scrub must find NOTHING left
+            for ps in range(pg_num):
+                await c.scrub_pg((self.pool_id, ps))
+            for ps in range(pg_num):
+                report = await c.scrub_pg((self.pool_id, ps))
+                inconsistent.extend(report["inconsistent"])
+
+        mismatches = await self.workload.verify() if converged else []
+        passed = (converged and not inconsistent and not mismatches
+                  and not self.workload.read_mismatches)
+        return {
+            "seed": self.seed,
+            "duration": self.duration,
+            "events": [[e.t, e.kind, e.target] for e in self.applied],
+            "writes_acked": self.workload.writes_acked,
+            "write_retries": self.workload.write_retries,
+            "client_op_retries": getattr(c.client, "op_retries", 0),
+            "read_checks": self.workload.read_checks,
+            "read_transients": self.workload.read_transients,
+            "read_mismatches": list(self.workload.read_mismatches),
+            "converged": converged,
+            "scrub_inconsistent": [o.decode(errors="replace")
+                                   for o in inconsistent],
+            "oracle_mismatches": mismatches,
+            "faults_injected": self.plane.injected(),
+            "passed": passed,
+        }

@@ -413,7 +413,13 @@ class MECSubReadReply(Message):
         ("digest", "u32"),  # stored hinfo crc for the returned chunk
         ("size", "u64"),  # stored whole-object size attr
         ("attrs", "map:str:bytes"),  # user xattrs (mirrored per shard)
+        # the shard's stored ATTR_V: the primary cross-checks versions
+        # across fetched shards and excludes laggards — a revived stale
+        # shard is self-consistent against its own stale hinfo, so only
+        # the version can unmask it (the ROADMAP stale-shard gap)
+        ("ver", EVERSION),
     )
+    DEFAULTS = {"ver": (0, 0)}
 
 
 # ---------------------------------------------------------------- peering
@@ -449,15 +455,28 @@ class MPushOp(Message):
         ("epoch", "u32"),
         ("force", "u8"),
         ("last_update", EVERSION),  # pushes end with the log point covered
+        # push-round id echoed in MPushReply: a recovery push and a
+        # read-triggered repair of the SAME (pg, shard, oid) can be in
+        # flight together, and their ack waiters must not collide
+        ("tid", "u64"),
+        # compare-and-swap guard for repair pushes (sent OUTSIDE the
+        # PG lock): install only while the receiver's copy is still at
+        # this version — a racing client write that moved it past must
+        # win, and a deliberate rollback of unacked-fanout debris
+        # names exactly the orphan version it replaces. The all-ones
+        # sentinel (default) means unconditional (recovery/backfill).
+        ("expect", EVERSION),
     )
-    DEFAULTS = {"force": 1}
+    DEFAULTS = {"force": 1, "tid": 0,
+                "expect": (0xFFFFFFFF, 0xFFFFFFFFFFFFFFFF)}
 
 
 @register_message
 class MPushReply(Message):
     TYPE = 43
     FIELDS = (("pgid", PGID), ("shard", "i32"), ("oid", "bytes"),
-              ("result", "i32"))
+              ("result", "i32"), ("tid", "u64"))  # echoes MPushOp.tid
+    DEFAULTS = {"tid": 0}
 
 
 @register_message

@@ -549,6 +549,24 @@ class MonLite:
 
     # -------------------------------------------------------------- health
 
+    def health(self) -> dict:
+        """Compact cluster-health digest (the `ceph health` summary
+        role): what a thrash verdict needs to judge convergence —
+        everyone up and in, no pg_temp pins left, no FULL pools."""
+        up = sum(1 for st in self.osdmap.osds if st.up)
+        out = sum(1 for st in self.osdmap.osds if st.weight == 0)
+        return {
+            "epoch": self.osdmap.epoch,
+            "n_osds": self.osdmap.n_osds,
+            "osds_up": up,
+            "osds_out": out,
+            "pg_temp_pins": len(self.osdmap.pg_temp),
+            "full_pools": dict(self.full_pools),
+            "ok": (up == self.osdmap.n_osds and out == 0
+                   and not self.osdmap.pg_temp
+                   and not self.full_pools),
+        }
+
     async def _mark_down(self, osd: int) -> None:
         inc = self._new_inc()
         inc.down.append(osd)

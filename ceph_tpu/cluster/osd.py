@@ -78,6 +78,9 @@ class OSDLite:
         self.fault = FaultInjector()
         self.perf = PerfCounters(self.name)
         self._declare_counters()
+        # every injection surfaces as a faults_injected_<site> counter
+        # (declared lazily: sites are an open set)
+        self.fault.on_fire = self._count_injection
         # recovery/backfill concurrency bounds (AsyncReserver role,
         # src/common/AsyncReserver.h + osd_max_backfills): LOCAL slots
         # gate this OSD's own recovery work as a primary; REMOTE slots
@@ -113,12 +116,21 @@ class OSDLite:
             idle_probe=lambda: (
                 len(self.op_scheduler) == 0
                 and len(self.optracker.in_flight)
-                <= self.ec_batcher.parked() + self.op_lock_waiters))
+                <= self.ec_batcher.parked() + self.op_lock_waiters),
+            fault=self.fault)
         self.throttle = Throttle(self.conf["osd_client_message_size_cap"])
         self.optracker = OpTracker()
         self.tracer = trace.get_tracer(self.name)
         self.pending: dict = {}  # key -> Future (sub-op replies)
-        self._subtid = 0
+        # sub-op tids carry an incarnation nonce in the high bits (the
+        # same trick the client's reqid tids use): a revived OSD reuses
+        # its entity NAME on the bus, so a late reply addressed to the
+        # dead incarnation would otherwise resolve the new one's
+        # counter-colliding wait — an all-ack spoofed by ghosts
+        # (thrash-found: a write "acked" with zero remote applies)
+        import secrets
+
+        self._subtid = secrets.randbits(31) << 32
         self._codecs: dict[int, object] = {}
         self._sinfos: dict[int, object] = {}
         #: pool id -> removed_snaps intervals already trimmed by this OSD
@@ -145,11 +157,33 @@ class OSDLite:
         p.add_u64_counter("recovery_pushes", "objects pushed to peers")
         p.add_u64_counter("recovery_unfound",
                           "objects skipped as unrecoverable")
+        p.add_u64_counter("ec_read_crc_err",
+                          "EC read-path hinfo CRC mismatches (rot)")
+        p.add_u64_counter("ec_read_stale_shard",
+                          "version-lagging shards excluded from EC "
+                          "reads/reconstructs (ATTR_V cross-check)")
+        p.add_u64_counter("ec_read_repairs",
+                          "read-triggered shard repair rounds completed"
+                          " (a CAS-miss skip counts: the copy moved on,"
+                          " which also ends the repair)")
+        p.add_u64_counter("ec_stray_reads",
+                          "reconstructs that widened the candidate pool"
+                          " to prior-interval stray shard copies")
         p.add_u64_counter("scrubs", "scrub rounds executed")
         p.add_u64_counter("snap_trims", "objects snap-trimmed")
         p.add_u64_counter("pg_splits", "child PGs split from parents")
         p.add_u64_counter("pg_merges", "child PGs merged into parents")
         p.add_u64_counter("map_epochs", "osdmap epochs consumed")
+
+    def _count_injection(self, site: str) -> None:
+        """FaultInjector.on_fire hook: faults_injected_<site> counters,
+        declared on first fire (sites are an open set)."""
+        key = f"faults_injected_{site}"
+        try:
+            self.perf.inc(key)
+        except KeyError:
+            self.perf.add_u64_counter(key, f"injected {site} faults")
+            self.perf.inc(key)
 
     # ----------------------------------------------------------- plumbing
 
@@ -600,8 +634,8 @@ class OSDLite:
                 await pg.handle_push(src, msg)
         elif isinstance(msg, M.MPushReply):
             osd_id = int(src[4:])
-            self._resolve(("pushr", msg.pgid, msg.shard, msg.oid, osd_id),
-                          msg)
+            self._resolve(("pushr", msg.pgid, msg.shard, msg.oid, osd_id,
+                           msg.tid), msg)
         elif isinstance(msg, M.MScrubReply):
             self._resolve(msg.tid, msg)
 
@@ -609,6 +643,11 @@ class OSDLite:
                          tracked=None) -> None:
         if tracked is not None:
             tracked.mark("dequeued")
+        # injected per-op stall (ms_inject_delay cousin). Deliberately
+        # BEFORE any PG lock is taken: fault pauses under a PG lock
+        # would stall the whole PG, which tpulint's lock-discipline
+        # rule forbids.
+        await self.fault.pause("op_dispatch_delay", tid=msg.tid)
         try:
             if msg.epoch > self.epoch:
                 # the sender has a NEWER map (OSD::wait_for_new_map
@@ -690,6 +729,15 @@ class OSDLite:
                 # every wait-for-clean
                 return pg
             self.pgs[key] = pg
+            if pool is not None:
+                # classify NOW, not at the next map change: a late or
+                # duplicated sub-op (thrash remaps produce plenty) can
+                # create this instance for a shard position the current
+                # map gives someone else — without this, the shell
+                # keeps the constructor's 'peering' until a map change
+                # that may never come, wedging wait-for-clean exactly
+                # like the merged-away zombie above (thrash-found)
+                pg.on_map(pg.acting, pg.primary)
         return pg
 
     def _split_pool_children(self, pool, prev_pg_num: int) -> None:

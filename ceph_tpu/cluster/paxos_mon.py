@@ -412,6 +412,28 @@ class PaxosMon(MonLite):
             pass
 
     async def _handle_elect(self, src: str, msg: M.MMonElect) -> None:
+        if self.is_leader() and msg.rank > self.rank:
+            # a higher rank campaigning means it has no leader: either
+            # a latecomer whose ack missed our round, or a revived
+            # member that lost its state. FOLD it in and re-announce
+            # victory instead of tearing a working leadership down — a
+            # full re-election both aborts any in-flight paxos round
+            # and can strand the same slow mon again (its ack racing
+            # the same window); the re-announce alone tells a revived
+            # member who leads
+            self.election_epoch = max(self.election_epoch, msg.epoch)
+            self.quorum.add(msg.rank)
+            for r in self.peers():
+                try:
+                    await self.bus.send(
+                        self.name, f"mon.{r}",
+                        M.MMonVictory(epoch=self.election_epoch,
+                                      leader=self.rank,
+                                      quorum=sorted(self.quorum)),
+                    )
+                except Exception:
+                    pass
+            return
         if msg.rank < self.rank:
             # support the better candidate, drop any claim of our own,
             # and DEFER: stop proposing while their round completes
@@ -431,9 +453,15 @@ class PaxosMon(MonLite):
                     self.name, src,
                     M.MMonElectAck(epoch=msg.epoch, rank=self.rank),
                 )
-        elif not self._electing:
-            # a lower rank (us) should lead: counter-propose, unless a
-            # round of ours is already in flight
+        elif not self._electing and (
+                self.leader is None
+                or (time.monotonic() - self._last_lease)
+                > self.election_timeout):
+            # a lower rank (us) should lead: counter-propose — but only
+            # when leadership is actually in doubt. A higher rank
+            # knocking to REJOIN a healthy quorum is the leader's
+            # fold-in to answer; counter-proposing here would tear the
+            # quorum down for every join attempt.
             await self._start_election()
 
     def _handle_victory(self, msg: M.MMonVictory) -> None:
@@ -446,7 +474,15 @@ class PaxosMon(MonLite):
             self._last_lease = time.monotonic()
 
     def _handle_lease(self, msg: M.MMonLease) -> None:
-        if msg.leader == self.leader:
+        # a lease extends OUR standing only if the quorum includes us:
+        # a mon whose election ack was lost (boot race, partition,
+        # CPU-starved under load) gets a victory/quorum that EXCLUDES
+        # it — treating the leader's leases as membership would park it
+        # outside the quorum forever (observed: quorum [1,2] wedged for
+        # minutes with mon.0 alive). Left stale, the election loop
+        # calls a rejoin round within election_timeout and the defer
+        # rule folds everyone into a full quorum.
+        if msg.leader == self.leader and self.rank in self.quorum:
             self._last_lease = time.monotonic()
 
     async def _handle_collect(self, src: str, msg: M.MPaxosCollect) -> None:

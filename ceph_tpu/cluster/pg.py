@@ -42,7 +42,8 @@ from ..utils import trace as tr
 from . import messages as M
 from . import snaps as sn
 from . import stripe as st
-from .pglog import OP_DELETE, OP_MODIFY, ZERO, Entry, PGInfo, PGLog
+from .pglog import (OP_DELETE, OP_MODIFY, ZERO, Entry, PGInfo, PGLog,
+                    dec_missing, enc_missing)
 
 
 def _trace_ctx() -> tuple[int, int]:
@@ -55,10 +56,21 @@ if TYPE_CHECKING:
 
 NONE = 0x7FFFFFFF  # placement ITEM_NONE
 META_OID = PGMETA_OID  # the per-PG metadata object (store/base.py)
+#: MPushOp.expect sentinel: no compare-and-swap, install unconditionally
+UNCOND = (0xFFFFFFFF, 0xFFFFFFFFFFFFFFFF)
+
+#: seconds a missing object must stay unreconstructable across peering
+#: rounds before it is classified unfound and the peer's log converges
+#: over the gap. Must outlast a daemon flap (kill -> revive -> osdmap):
+#: a too-eager skip on several members drops an acked generation below
+#: k and scrub rolls it back as orphan debris (acked-write loss).
+UNFOUND_GRACE = 8.0
 
 ATTR_V = "v"
 ATTR_SIZE = "size"
 ATTR_HINFO = "hinfo"
+#: pgmeta attr holding the persisted missing-set (pg_missing_t role)
+ATTR_PGMISS = "pgmissing"
 ATTR_SS = "ss"  # head SnapSet (the SS_ATTR role)
 ATTR_WHITEOUT = "wh"  # deleted head kept for its clones (snapdir role)
 USER_ATTR = "u:"  # user xattr namespace within store attrs
@@ -99,6 +111,64 @@ class OpError(Exception):
     def __init__(self, code: int, what: str = ""):
         super().__init__(what or str(code))
         self.code = code
+
+
+class HinfoError(IOError):
+    """A chunk failed its stored per-cell hinfo CRC (bit rot) — kept
+    distinct from plain EIO so the read path can count it
+    (ec_read_crc_err) and kick a repair."""
+
+
+def _best_version_group(pool: dict, vers: dict, k: int) -> dict | None:
+    """Newest version group with >= k members among fetched shards.
+
+    The fallback when completing the newest generation to k members is
+    impossible: an interrupted write fan-out leaves a minority of
+    shards one version ahead — that generation was never ack-able (the
+    client never saw it commit), so the newest generation that CAN
+    decode (>= k same-version members) is the correct, consistent
+    read; the client's retry re-applies the interrupted write. None
+    when no generation has k members (genuinely unreconstructable)."""
+    groups: dict[tuple, list] = {}
+    for j in pool:
+        groups.setdefault(vers.get(j, ZERO), []).append(j)
+    ok = [v for v, members in groups.items() if len(members) >= k]
+    if not ok:
+        return None
+    v = max(ok)
+    return {j: pool[j] for j in groups[v]}
+
+
+def _assemble_generation(copies: list, k: int):
+    """Newest generation with >= k distinct shard positions across a
+    MULTI-SOURCE candidate pool — current holders plus prior-interval
+    strays, so one position may appear at several versions (unlike
+    _best_version_group's one-copy-per-position dict). ``copies`` is
+    [(ver, pos, chunk, size_attr or None, attrs dict)]. Returns the
+    rebuilt (chunks, vers, size_attrs, attrs_by) dicts for that
+    generation, or None when no generation reaches k positions."""
+    groups: dict[tuple, dict[int, tuple]] = {}
+    for ver, pos, chunk, size_attr, attrs in copies:
+        ver = tuple(ver)
+        if ver == ZERO:
+            continue
+        groups.setdefault(ver, {}).setdefault(
+            pos, (chunk, size_attr, attrs))
+    ok = [v for v, members in groups.items() if len(members) >= k]
+    if not ok:
+        return None
+    v = max(ok)
+    chunks: dict[int, bytes] = {}
+    vers: dict[int, tuple[int, int]] = {}
+    size_attrs: dict[int, bytes] = {}
+    attrs_by: dict[int, dict] = {}
+    for pos, (chunk, size_attr, attrs) in groups[v].items():
+        chunks[pos] = chunk
+        vers[pos] = v
+        if size_attr is not None:
+            size_attrs[pos] = size_attr
+        attrs_by[pos] = attrs
+    return chunks, vers, size_attrs, attrs_by
 
 
 def enc_ver(v: tuple[int, int]) -> bytes:
@@ -206,14 +276,20 @@ class _OpState:
         materialized and no data mutation is pending, this is a ranged
         fetch — an EC object read moves O(range), not O(object)."""
         if self._data is None and self.ov.empty:
-            end = self.size if length < 0 else min(offset + length,
-                                                   self.size)
-            if end <= offset or not self.exists0:
+            if not self.exists0:
                 return b""
             if self.pg.is_ec:
+                # range clamping is _read_ec's job: our size0 came from
+                # the primary's own shard attr, which may be the stale
+                # one (revived primary) — _read_ec resolves the
+                # authoritative size across the fetched quorum
                 data, _sz = await self.pg._read_ec(self.oid, offset,
-                                                   end - offset)
+                                                   length)
                 return data
+            end = self.size if length < 0 else min(offset + length,
+                                                   self.size)
+            if end <= offset:
+                return b""
             return bytes(self.pg.osd.store.read(self.pg.cid, self.oid,
                                                 offset, end - offset))
         data = await self.materialize()
@@ -344,6 +420,12 @@ class _OpState:
 
 
 class PG:
+    #: EC reads cross-check ATTR_V across fetched shards and exclude
+    #: version-lagging ones (the ROADMAP stale-shard fix). Class-level
+    #: so the regression test can flip it off to demonstrate the seed
+    #: read path serving mixed-generation cells.
+    _ec_version_check = True
+
     def __init__(self, osd: "OSDLite", pgid: tuple[int, int], shard: int):
         self.osd = osd
         self.pgid = pgid
@@ -404,6 +486,43 @@ class PG:
         #: Re-seeded from the log head at activation — peering has just
         #: converged every member to our log by then.
         self.acked_head: tuple[int, int] = ZERO
+        #: (oid, shard) repairs currently in flight — a burst of reads
+        #: hitting one rotten shard must queue ONE repair, not a storm
+        self._repairing: set[tuple[bytes, int]] = set()
+        #: reqids of our own unacked in-flight log tail, detected at
+        #: activation: the reply-cache rebuild must never fabricate an
+        #: OK for them (phantom ack); a real re-execution clears them.
+        #: dict-as-ordered-set so the size cap evicts the OLDEST entry
+        #: (an arbitrary eviction could drop a reqid still guarding)
+        self._phantom_reqids: dict[tuple, None] = {}
+        #: oid -> loop time of the FIRST of an unbroken run of failed
+        #: reconstructs in peering's peer-recovery push; entries gate
+        #: the unfound classification behind UNFOUND_GRACE and clear on
+        #: a successful push (or at activation)
+        self._unfound_since: dict[bytes, float] = {}
+        #: oid -> newest version whose CONTENT this member lacks even
+        #: though its log position claims it (pg_missing_t role):
+        #: populated when a head converges over a skipped unfound push
+        #: or an adopted log's reconstruct failed, cleared when content
+        #: actually lands (push install, successful reconstruct, a full
+        #: rewrite, a delete). PERSISTED next to the log — it must
+        #: survive daemon restarts and primary changes, because the
+        #: activation reply-cache rebuild trusts peer heads: without
+        #: this set, a flapped-in primary would fabricate an OK for a
+        #: write whose cells never reached k shards (converged heads
+        #: are log position, not content — thrash-found acked-write
+        #: loss: the client stops resending and the generation can
+        #: never decode)
+        self.missing: dict[bytes, tuple[int, int]] = {}
+        #: oid -> our own shard's ATTR_V at which a quorum probe last
+        #: confirmed the local size attr is authoritative. A past-EOF
+        #: read that finds the entry matching the CURRENT local version
+        #: skips the probe: the stale-size hazard needs a revived-stale
+        #: primary, and revival starts with this (in-memory) cache cold
+        #: while any local write bumps ATTR_V past the cached value.
+        #: Capped (oldest-out) so a long-lived primary's memory stays
+        #: bounded by the hot set, not the object population.
+        self._size_probe_ok: dict[bytes, tuple[int, int]] = {}
         self._load()
 
     # ----------------------------------------------------------- identity
@@ -461,10 +580,22 @@ class PG:
                 return
             if raw:
                 self.log, _ = PGLog.decode(raw)
+            try:
+                self.missing, _ = dec_missing(
+                    store.getattr(self.cid, META_OID, ATTR_PGMISS))
+            except Exception:
+                self.missing = {}
 
     def _ensure_coll(self, t: tx.Transaction) -> None:
         if self.cid not in self.osd.store.list_collections():
             t.create_collection(self.cid)
+
+    def _persist_missing(self, t: tx.Transaction,
+                         cid: str | None = None) -> None:
+        """Persist the missing-set as a pgmeta attr in the same
+        transaction as whatever state change created/cleared it."""
+        t.setattr(self.cid if cid is None else cid, META_OID,
+                  ATTR_PGMISS, enc_missing(self.missing))
 
     def _persist_log(self, t: tx.Transaction,
                      cid: str | None = None) -> None:
@@ -690,7 +821,10 @@ class PG:
             self._req_inflight.discard(key)
             if reply.result != M.EAGAIN:
                 # EAGAIN asks the client to retry the SAME tid — caching
-                # it would freeze the failure; cache only final results
+                # it would freeze the failure; cache only final results.
+                # A real execution also clears any phantom blacklisting
+                # of this reqid (see the peering-time cache rebuild).
+                self._phantom_reqids.pop(key, None)
                 self._req_replies[key] = reply
                 while len(self._req_replies) > 512:
                     self._req_replies.popitem(last=False)
@@ -937,8 +1071,11 @@ class PG:
             raise OpError(EOPNOTSUPP, "omap on EC pool")
 
     def _object_version(self, oid: bytes) -> tuple[int, int]:
+        return self._shard_obj_version(self.cid, oid)
+
+    def _shard_obj_version(self, cid: str, oid: bytes) -> tuple[int, int]:
         try:
-            return dec_ver(self.osd.store.getattr(self.cid, oid, ATTR_V))
+            return dec_ver(self.osd.store.getattr(cid, oid, ATTR_V))
         except Exception:
             return ZERO
 
@@ -1346,6 +1483,25 @@ class PG:
         ack when every live shard commits."""
         osd = self.osd
         version = entries[-1].version
+        # the primary's own shard honors the SAME missing-base bounce
+        # handle_ec_write gives peers: a delta over a base we never
+        # recovered (head converged over a skipped unfound push) would
+        # stamp the new version + copied hinfo over absent cells —
+        # zeros that HASH as zero cells, corruption neither the CRC nor
+        # the ATTR_V cross-check can convict. Bounce before anything is
+        # sent; re-peering recovers (or honestly re-records) the base
+        # and the client's retry lands on a whole object.
+        if oid in self.missing:
+            for pos, t in shard_txns.items():
+                if live.get(pos) != osd.id:
+                    continue
+                hp = hpatch[pos] if isinstance(hpatch, dict) else hpatch
+                if not self._write_covers_base(t, oid, hp, ncells):
+                    self._mig_fanout_done(oid, ok=False)
+                    self._repeer_on_subop_failure()
+                    raise RuntimeError(
+                        f"own shard {pos} of {oid!r} misses its base: "
+                        "delta write bounced pending recovery")
         waits = []
         extra_waits = []
         for pos, t in shard_txns.items():
@@ -1401,6 +1557,20 @@ class PG:
                     asyncio.get_running_loop().create_task(
                         self._peer_and_recover()))
 
+    @staticmethod
+    def _write_covers_base(t: tx.Transaction, oid: bytes,
+                           hpatch: bytes, ncells: int) -> bool:
+        """True when an EC sub-write needs no pre-existing base: it
+        removes the object, or its CRC patch covers EVERY cell (a full
+        rewrite replaces the whole shard file)."""
+        if any(op.code == tx.OP_REMOVE and op.oid == oid
+               for op in t.ops):
+            return True
+        if not hpatch or not ncells:
+            return False
+        cols = np.frombuffer(hpatch, dtype="<u4").reshape(-1, 2)[:, 0]
+        return len(np.unique(cols[cols < ncells])) >= ncells
+
     def _apply_shard_write(self, cid: str, t: tx.Transaction,
                            entries: list[Entry], hpatch: bytes,
                            ncells: int, size: int, version) -> None:
@@ -1436,11 +1606,24 @@ class PG:
                 ATTR_SIZE: denc.enc_u64(size),
                 ATTR_V: enc_ver(version),
             })
+        if oid in self.missing and self._write_covers_base(
+                t, oid, hpatch, ncells):
+            # delete, or full rewrite of every cell: the base content
+            # we were missing no longer matters. (Partial deltas were
+            # already bounced in handle_ec_write and stay missing.)
+            self.missing.pop(oid, None)
+            self._persist_missing(full, cid)
         for entry in entries:
             if entry.version > self.log.head:
                 self.log.append(entry)
         self.log.trim(osd.log_keep)
         self._persist_log(full, cid)
+        if osd.fault.hit("torn_write", oid=oid):
+            # torn write: only a prefix of the shard transaction
+            # reaches disk (pulled-plug shape) — the data lands without
+            # its CRC/size/version attrs or log suffix, and scrub /
+            # peering must detect and repair the divergence
+            full.ops = full.ops[: max(1, len(full.ops) // 2)]
         osd.store.queue_transaction(full)
 
     async def _ec_remote_meta(self, oid: bytes):
@@ -1479,12 +1662,26 @@ class PG:
         sub-reads verify per-cell hinfo CRCs, decode rebuilds missing
         data cells. A failed sub-read (EIO, hinfo mismatch, lost chunk)
         excludes that shard and re-plans the fetch set from survivors —
-        the reconstruct-on-read arc of test-erasure-eio.sh."""
+        the reconstruct-on-read arc of test-erasure-eio.sh.
+
+        Version hardening (the ROADMAP stale-shard fix): fetched shards
+        also cross-check ATTR_V — a revived stale shard is self-
+        consistent against its own stale hinfo, so version lag is the
+        ONLY signal that excludes it; laggards are demoted exactly like
+        hinfo failures and the read decodes from the surviving quorum.
+        When the newest generation cannot reach k members (a write
+        fan-out died mid-flight), the read falls back to the newest
+        generation that can — see _best_version_group. The
+        authoritative size is the served generation's, and a fetch
+        planned on a stale local size attr is re-planned. Shards left
+        behind the served generation get an async repair kicked."""
         osd = self.osd
         codec = osd.codec_for(self.pool)
         si = osd.sinfo_for(self.pool)
         k = codec.k
         live = {s: o for o, s in self.live_members()}
+        verify = bool(osd.conf["osd_ec_verify_on_read"])
+        want = [codec.chunk_index(i) for i in range(k)]
         size = None
         try:
             size = denc.dec_u64(
@@ -1492,86 +1689,186 @@ class PG:
             )[0]
         except Exception:
             pass
-        if size is not None:
-            end = size if length < 0 else min(offset + length, size)
-            if end <= offset:
-                return b"", size
-            s0, s1 = si.stripe_span(offset, end - offset)
-            coff, clen = s0 * si.su, (s1 - s0) * si.su
-        else:
-            # size unknown (no local shard): fetch whole shard files
-            s0, coff, clen = 0, 0, -1
-        want = [codec.chunk_index(i) for i in range(k)]
         chunks: dict[int, bytes] = {}
+        #: version-demoted shards: excluded from the fetch plan but
+        #: their data is KEPT for the group fallback
+        demoted: dict[int, bytes] = {}
+        vers: dict[int, tuple[int, int]] = {}
+        sizes: dict[int, int] = {}
         failed: set[int] = set()
         enoent = 0
-        while True:
-            usable = [s for s in sorted(live) if s not in failed]
-            try:
-                need = codec.minimum_to_decode(want, usable)
-            except Exception:
-                # not enough healthy shards left
-                if enoent and not chunks:
-                    raise KeyError(oid)  # object genuinely absent
-                raise IOError(
-                    f"cannot reconstruct {oid!r}: shards {sorted(failed)} "
-                    f"unreadable"
-                )
-            waits = []
-            for j in sorted(need):
-                if j in chunks:
-                    continue
-                target = live[j]
-                if target == self.osd.id:
-                    cid = self._shard_cid(j)
-                    try:
-                        if osd.fault.hit("ec_local_read", oid=oid,
-                                         shard=j):
-                            raise IOError("injected local EIO")
-                        chunk = bytes(osd.store.read(cid, oid, coff,
-                                                     clen))
-                        self._verify_hinfo(cid, oid, chunk,
-                                           first_cell=s0)
-                        chunks[j] = chunk
-                        if size is None:
-                            size = denc.dec_u64(
-                                osd.store.getattr(cid, oid, ATTR_SIZE),
-                                0,
-                            )[0]
-                    except NotFound:
-                        enoent += 1
-                        failed.add(j)
-                    except IOError:
-                        failed.add(j)
-                    continue
-                subtid = osd.new_subtid()
-                fut = osd.expect_reply(subtid)
-                waits.append((j, target, subtid, fut))
-                await osd.send(
-                    f"osd.{target}",
-                    M.MECSubRead(tid=subtid, pgid=self.pgid, shard=j,
-                                 oid=oid, offset=coff, length=clen,
-                                 trace=_trace_ctx()),
-                )
-            for j, target, subtid, fut in waits:
-                reply = await osd.await_reply(subtid, fut, target)
-                if reply.result == M.OK:
-                    chunks[j] = reply.data
-                    if size is None:
-                        size = reply.size
+        for _replan in range(4):
+            if size is not None:
+                end = size if length < 0 else min(offset + length, size)
+                if end <= offset:
+                    if not (self._ec_version_check and live):
+                        return b"", size
+                    myver = self._object_version(oid)
+                    if (myver != ZERO
+                            and self._size_probe_ok.get(oid) == myver):
+                        return b"", size
+                    # the local size attr may itself be the stale one
+                    # (this primary can be the revived shard): probe
+                    # one cell of offset's stripe — even an empty-range
+                    # reply carries the shard's true size and version —
+                    # before declaring the range past EOF. The post-
+                    # fetch authoritative size settles it either way.
+                    s0, s1 = si.stripe_span(offset, 1)
+                    coff, clen = s0 * si.su, (s1 - s0) * si.su
                 else:
-                    if reply.result == M.ENOENT:
-                        enoent += 1
-                    failed.add(j)
-            if all(j in chunks for j in need):
+                    s0, s1 = si.stripe_span(offset, end - offset)
+                    coff, clen = s0 * si.su, (s1 - s0) * si.su
+            else:
+                # size unknown (no local shard): fetch whole shard files
+                s0, s1 = 0, 0
+                coff, clen = 0, -1
+            while True:
+                usable = [s for s in sorted(live) if s not in failed]
+                try:
+                    need = codec.minimum_to_decode(want, usable)
+                except Exception:
+                    # not enough non-demoted shards left: fall back to
+                    # the newest generation with >= k fetched members
+                    fb = _best_version_group({**demoted, **chunks},
+                                             vers, k)
+                    if fb is not None:
+                        chunks = fb
+                        break
+                    if enoent and not chunks and not demoted:
+                        raise KeyError(oid)  # object genuinely absent
+                    raise IOError(
+                        f"cannot reconstruct {oid!r}: shards "
+                        f"{sorted(failed)} unreadable"
+                    )
+                waits = []
+                for j in sorted(need):
+                    if j in chunks:
+                        continue
+                    target = live[j]
+                    if target == self.osd.id:
+                        cid = self._shard_cid(j)
+                        try:
+                            if osd.fault.hit("ec_local_read", oid=oid,
+                                             shard=j):
+                                raise IOError("injected local EIO")
+                            chunk = bytes(osd.store.read(cid, oid, coff,
+                                                         clen))
+                            chunk = self._maybe_bitflip(chunk, oid, j)
+                            if verify:
+                                self._verify_hinfo(cid, oid, chunk,
+                                                   first_cell=s0)
+                            chunks[j] = chunk
+                            vers[j] = self._shard_obj_version(cid, oid)
+                            try:
+                                sizes[j] = denc.dec_u64(
+                                    osd.store.getattr(cid, oid,
+                                                      ATTR_SIZE), 0)[0]
+                            except Exception:
+                                pass
+                            if size is None:
+                                size = sizes.get(j)
+                        except NotFound:
+                            enoent += 1
+                            failed.add(j)
+                        except HinfoError:
+                            osd.perf.inc("ec_read_crc_err")
+                            failed.add(j)
+                            self._kick_read_repair(
+                                oid, j, live,
+                                self._shard_obj_version(cid, oid))
+                        except IOError:
+                            failed.add(j)
+                        continue
+                    subtid = osd.new_subtid()
+                    fut = osd.expect_reply(subtid)
+                    waits.append((j, target, subtid, fut))
+                    await osd.send(
+                        f"osd.{target}",
+                        M.MECSubRead(tid=subtid, pgid=self.pgid, shard=j,
+                                     oid=oid, offset=coff, length=clen,
+                                     trace=_trace_ctx()),
+                    )
+                for j, target, subtid, fut in waits:
+                    reply = await osd.await_reply(subtid, fut, target)
+                    if reply.result == M.OK:
+                        chunks[j] = reply.data
+                        vers[j] = tuple(reply.ver)
+                        sizes[j] = reply.size
+                        if size is None:
+                            size = reply.size
+                    else:
+                        if reply.result == M.ENOENT:
+                            enoent += 1
+                        elif reply.result == M.EIO:
+                            # shard-side hinfo/IO failure: repair it
+                            self._kick_read_repair(oid, j, live)
+                        failed.add(j)
+                if not all(j in chunks for j in need):
+                    continue
+                if self._ec_version_check and vers:
+                    vmax = max(vers.get(j, ZERO) for j in chunks)
+                    stale = [j for j in chunks
+                             if vers.get(j, ZERO) < vmax]
+                    if stale:
+                        # version-lagging shards are demoted exactly
+                        # like hinfo-CRC failures and the plan retried
+                        # from survivors; their data is kept in case
+                        # the newest generation can't reach k and the
+                        # group fallback has to serve the older one
+                        for j in stale:
+                            demoted[j] = chunks.pop(j)
+                            failed.add(j)
+                        continue
                 break
-        if size is None:
-            raise KeyError(oid)
-        if clen == -1:
-            # size learned late: the whole-file fetch covers everything
+            # true laggards — behind the generation actually served —
+            # are counted and repaired; shards the fallback judged
+            # AHEAD of the served generation are not stale
+            sel_ver = max((vers.get(j, ZERO) for j in chunks),
+                          default=ZERO)
+            for j in demoted:
+                if j not in chunks and vers.get(j, ZERO) < sel_ver:
+                    osd.perf.inc("ec_read_stale_shard")
+                    self._kick_read_repair(oid, j, live, vers.get(j))
+            # authoritative size: the served generation's size attr
+            # (the primary's own attr may be the stale one)
+            if vers and chunks:
+                best = max(chunks, key=lambda j: vers.get(j, ZERO))
+                bsize = sizes.get(best)
+                if bsize is not None and vers.get(best, ZERO) != ZERO:
+                    size = bsize
+            if size is None:
+                raise KeyError(oid)
             end = size if length < 0 else min(offset + length, size)
             if end <= offset:
+                # the quorum confirmed our local attrs are current:
+                # later past-EOF reads of this oid can skip the probe
+                # until a local write bumps our shard's ATTR_V
+                myver = self._object_version(oid)
+                if myver != ZERO and chunks and myver == max(
+                        vers.get(j, ZERO) for j in chunks):
+                    self._size_probe_ok.pop(oid, None)
+                    self._size_probe_ok[oid] = myver
+                    while len(self._size_probe_ok) > 4096:
+                        del self._size_probe_ok[
+                            next(iter(self._size_probe_ok))]
                 return b"", size
+            if clen != -1 and end > s1 * si.width:
+                # the fetch was planned on a stale (smaller) size: the
+                # range misses stripes of the authoritative object —
+                # refetch wider. Shards that failed for real (EIO,
+                # hinfo, ENOENT) stay excluded, but version-demoted
+                # ones must rejoin the plan: when the group fallback
+                # just chose THEIR generation, leaving them in
+                # ``failed`` would strand the only decodable copy
+                chunks.clear()
+                failed.difference_update(demoted)
+                demoted.clear()
+                vers.clear()
+                sizes.clear()
+                continue
+            break
+        else:
+            raise IOError(f"cannot plan a stable read of {oid!r}")
         # equalize lengths defensively (lagging shards), then decode
         want_missing = [p for p in want if p not in chunks]
         if want_missing:
@@ -1653,6 +1950,17 @@ class PG:
             out[:, i, :] = row.reshape(ncells, si.su)
         return out
 
+    def _maybe_bitflip(self, chunk: bytes, oid: bytes,
+                       shard: int) -> bytes:
+        """``ec_read_bitflip`` fault site for local shard reads: rot
+        must land BEFORE hinfo verification so the CRC check is what
+        catches it."""
+        if self.osd.fault.hit("ec_read_bitflip", oid=oid, shard=shard):
+            from .faults import flip_bit
+
+            chunk = flip_bit(chunk)
+        return chunk
+
     def _verify_hinfo(self, cid: str, oid: bytes, chunk: bytes,
                       first_cell: int = 0) -> None:
         """Per-cell CRC verification of a shard-file range starting at
@@ -1668,11 +1976,80 @@ class PG:
         for idx in range(len(cells)):
             actual = native.crc32c(np.ascontiguousarray(cells[idx]))
             if stored[first_cell + idx] != actual:
-                raise IOError(
+                raise HinfoError(
                     f"hinfo mismatch on {cid}/{oid!r} cell "
                     f"{first_cell + idx}: {stored[first_cell + idx]:#x}"
                     f" != {actual:#x}"
                 )
+
+    def _kick_read_repair(self, oid: bytes, shard: int,
+                          live: dict[int, int],
+                          observed: "tuple | None" = None) -> None:
+        """A read unmasked a bad shard copy (bit rot failing hinfo, or
+        a version-lagging revived shard): queue ONE asynchronous
+        reconstruct+reinstall instead of serving degraded until the
+        next scrub (the read-triggered repair arc of
+        test-erasure-eio.sh). Never blocks the read. ``observed`` is
+        the bad copy's version when known — the repair push CAS-es on
+        it so a racing write always wins."""
+        if not self.is_primary() or self.state != "active":
+            return
+        target = live.get(shard)
+        if target is None or (oid, shard) in self._repairing:
+            return
+        self._repairing.add((oid, shard))
+        self.osd.spawn(self._repair_shard(oid, shard, target, observed))
+
+    async def _repair_shard(self, oid: bytes, shard: int, target: int,
+                            observed: "tuple | None" = None) -> None:
+        """Rebuild shard ``shard`` from the surviving quorum and
+        reinstall it on its holder (self or peer). The reconstruct's
+        own version cross-check guarantees generation-consistent cells;
+        its attrs carry the version the rebuild represents."""
+        try:
+            async with self.lock:
+                chunk, attrs = await self._reconstruct_chunk(oid, shard)
+            version = (dec_ver(attrs[ATTR_V]) if ATTR_V in attrs
+                       else self._object_version(oid))
+            # CAS anchor: replace the version the read observed (rot
+            # keeps the version, so the rebuild's own label is the
+            # right anchor when the observation carried none)
+            expect = observed if observed is not None else version
+            if target == self.osd.id:
+                cid = self._shard_cid(shard)
+                t = tx.Transaction()
+                if cid not in self.osd.store.list_collections():
+                    t.create_collection(cid)
+                t.truncate(cid, oid, 0)
+                t.write(cid, oid, 0, chunk)
+                t.rmattrs(cid, oid)
+                t.setattrs(cid, oid,
+                           {**attrs, ATTR_V: enc_ver(version)})
+                self.osd.store.queue_transaction(t)
+            else:
+                tid = self.osd.new_subtid()
+                key = ("pushr", self.pgid, shard, oid, target, tid)
+                fut = self.osd.expect_reply(key)
+                await self.osd.send(
+                    f"osd.{target}",
+                    M.MPushOp(pgid=self.pgid, shard=shard, oid=oid,
+                              version=version, data=chunk, attrs=attrs,
+                              epoch=self.osd.epoch, force=1,
+                              last_update=self.log.head, tid=tid,
+                              expect=expect),
+                )
+                try:
+                    await asyncio.wait_for(fut, self.osd.subop_timeout)
+                except asyncio.TimeoutError:
+                    self.osd.drop_reply(key)
+                    return
+            self.osd.perf.inc("ec_read_repairs")
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            pass  # unreconstructable right now: scrub/peering retries
+        finally:
+            self._repairing.discard((oid, shard))
 
     # ================================================== sub-op handlers ==
 
@@ -1749,8 +2126,23 @@ class PG:
              else tx.Transaction.decode(m.txn)[0])
         entries = (m.entry if isinstance(m.entry, list)
                    else dec_entries(m.entry))
+        oid = entries[-1].oid
+        if oid in self.missing and not self._write_covers_base(
+                t, oid, m.hpatch, m.ncells):
+            # a DELTA patches cells of a base we do not hold (head
+            # converged over a skipped unfound push): applying it
+            # would stamp current attrs over zero-filled content that
+            # even hinfo cannot convict (absent cells hash as zero
+            # cells). Bounce so the primary re-peers and recovers (or
+            # keeps us honestly missing); a full rewrite passes.
+            await self.osd.send(
+                src,
+                M.MECSubWriteReply(tid=m.tid, pgid=self.pgid,
+                                   shard=m.shard, result=M.ESTALE),
+            )
+            return
         if (self._subop_fenced(src, m.prev_head)
-                or self._subop_misdirected(entries[-1].oid)):
+                or self._subop_misdirected(oid)):
             await self.osd.send(
                 src,
                 M.MECSubWriteReply(tid=m.tid, pgid=self.pgid,
@@ -1804,9 +2196,15 @@ class PG:
             else:
                 chunk = bytes(self.osd.store.read(self.cid, m.oid,
                                                   m.offset, m.length))
+                chunk = self._maybe_bitflip(chunk, m.oid, m.shard)
                 si = self.osd.sinfo_for(self.pool)
-                self._verify_hinfo(self.cid, m.oid, chunk,
-                                   first_cell=m.offset // si.su)
+                # recovery reads (whole-file) always verify — a rotted
+                # cell must never be rebuilt into another shard; the
+                # knob only relaxes the normal client-read path
+                if (self.osd.conf["osd_ec_verify_on_read"]
+                        or m.length == -1):
+                    self._verify_hinfo(self.cid, m.oid, chunk,
+                                       first_cell=m.offset // si.su)
             digest = native.crc32c(np.frombuffer(chunk, np.uint8)) \
                 if chunk else 0
             size = denc.dec_u64(
@@ -1822,7 +2220,13 @@ class PG:
             reply = M.MECSubReadReply(tid=m.tid, pgid=self.pgid,
                                       shard=m.shard, result=M.OK,
                                       data=chunk, digest=digest, size=size,
-                                      attrs=uattrs)
+                                      attrs=uattrs,
+                                      ver=self._object_version(m.oid))
+        except HinfoError:
+            self.osd.perf.inc("ec_read_crc_err")
+            reply = M.MECSubReadReply(tid=m.tid, pgid=self.pgid,
+                                      shard=m.shard, result=M.EIO,
+                                      data=b"", digest=0, size=0, attrs={})
         except (NotFound, KeyError):
             reply = M.MECSubReadReply(tid=m.tid, pgid=self.pgid,
                                       shard=m.shard, result=M.ENOENT,
@@ -1861,7 +2265,8 @@ class PG:
         epoch = osd.osdmap.epoch
         peers = [(o, s) for o, s in self.live_members() if o != osd.id]
         infos: dict[tuple[int, int], PGInfo] = {
-            (osd.id, self.shard): PGInfo(self.log.head, self.log)
+            (osd.id, self.shard): PGInfo(self.log.head, self.log,
+                                         dict(self.missing))
         }
         waits = []
         for o, s in peers:
@@ -1923,18 +2328,50 @@ class PG:
             # -- recover self to authoritative
             if best.last_update > self.log.head:
                 await self._recover_self(best_key, best)
+            # retry OUR OWN recorded content gaps (objects behind the
+            # converged head that never landed): members revived or
+            # strays reachable under the current map may make the
+            # reconstruct succeed now; a still-unfound object stays on
+            # record and never wedges the round
+            for moid, mver in list(self.missing.items()):
+                if self._subop_misdirected(moid):
+                    continue
+                try:
+                    await self._recover_own_chunk(moid, tuple(mver))
+                except RuntimeError:
+                    pass
 
             # -- recover peers (delta or backfill), a REMOTE slot on
             # each target bounding its inbound backfills
             for (o, s), info in infos.items():
-                if o == osd.id or info.last_update == self.log.head:
+                if o == osd.id:
+                    continue
+                if info.last_update == self.log.head:
+                    # heads agree, but content gaps recorded behind
+                    # the peer's converged head still want push
+                    # retries (same best-effort contract as above) —
+                    # under the SAME remote slot that bounds every
+                    # other inbound push: after a mass remap many
+                    # heads-agree primaries retry the same revived
+                    # peer's gaps at once, and each retry is a full
+                    # reconstruct + push. No slot, no retry this
+                    # round; the gap stays safely on record.
+                    if info.missing:
+                        if not await self._reserve_remote(o):
+                            continue  # saturated: retry next round
+                        reserved_remote.append(o)
+                        await self._retry_peer_missing(o, s, info)
                     continue
                 if not await self._reserve_remote(o):
                     return False  # target saturated: retry the round
                 reserved_remote.append(o)
                 missing = self.log.missing_after(info.last_update)
+                #: content pushes this round legitimately skipped as
+                #: unfound — shipped with the head push so the peer
+                #: RECORDS the gap its converged head papers over
+                skipped: dict[bytes, tuple[int, int]] = {}
                 if missing is None:
-                    await self._backfill_peer(o, s)
+                    skipped = await self._backfill_peer(o, s)
                 else:
                     all_acked = True
                     for oid, e in missing.items():
@@ -1949,15 +2386,34 @@ class PG:
                                 # retry the whole round instead
                                 all_acked = False
                         except RuntimeError:
-                            # unreconstructable (e.g. the log entry of
-                            # a bounced degraded write that never
-                            # reached k shards): the client's retry
-                            # re-created the object wherever it maps
-                            # now — do NOT wedge peering forever on it
-                            # (unfound-object role)
+                            # unreconstructable RIGHT NOW — usually a
+                            # transient (surviving-quorum members down
+                            # mid-flap), so retry the round within a
+                            # time budget: converging the peer's log
+                            # head over a gap a revived member could
+                            # still fill drops an ACKED generation
+                            # below k, and scrub then rolls it back as
+                            # orphan debris (acked-write loss, thrash-
+                            # found). Only an object that stays
+                            # unreconstructable across the budget —
+                            # the debris of a bounced degraded write
+                            # the client saw fail — is skipped, so
+                            # peering cannot wedge forever on it
+                            # (unfound-object role).
+                            now = asyncio.get_running_loop().time()
+                            since = self._unfound_since.setdefault(
+                                oid, now)
+                            if now - since < UNFOUND_GRACE:
+                                all_acked = False
+                                continue
+                            self._unfound_since.pop(oid, None)
+                            if e.op != OP_DELETE:
+                                skipped[oid] = e.version
                             osd.perf.inc("recovery_unfound")
                             osd.log_exc(
                                 f"pg {self.pgid} unfound {oid!r}")
+                        else:
+                            self._unfound_since.pop(oid, None)
                     if not all_acked:
                         return False
                 # converge the peer's LOG POSITION when every CONTENT
@@ -1967,7 +2423,13 @@ class PG:
                 # subsequent sub-write against the activation-seeded
                 # acked_head, a permanent livelock; round-4 EC-split
                 # finding). Push timeouts return above and retry.
-                await self._push_log_head(o, s)
+                # Skipped-unfound oids ride along: a head converged
+                # over a content gap must leave the gap ON RECORD at
+                # the peer, or a later primary's reply-cache rebuild
+                # reads the converged head as content-coverage and
+                # fabricates an ack for an undecodable write.
+                await self._push_log_head(o, s, skipped)
+                await self._retry_peer_missing(o, s, info, skipped)
         finally:
             if held_local:
                 osd.local_reserver.release(("pg", self.pgid))
@@ -1983,6 +2445,7 @@ class PG:
         if osd.osdmap.epoch != epoch:
             return False
         self.state = "active"
+        self._unfound_since.clear()
         # peering just converged every member to our log: everything in
         # it counts as acked for the prefix fence
         self.acked_head = self.log.head
@@ -1994,13 +2457,48 @@ class PG:
         # matter (cache cap), and a GENUINE cached reply — which may
         # carry a cls call's payload the log cannot reconstruct — must
         # never be overwritten by a fabricated bare-OK one.
+        #
+        # NEVER fabricate an OK for an entry this acting set cannot
+        # produce content for (thrash-found phantom ack): a primary
+        # appends locally BEFORE its fan-out gathers acks, so a failed
+        # fan-out leaves an entry whose cells may live on OUR shard
+        # alone — unrecoverable, and "acking" it from this cache loses
+        # the write silently. Prefix-shaped logs make coverage cheap:
+        # a member whose PRE-RECOVERY head >= version holds the entry,
+        # and an EC stripe needs k such members to decode (replicated
+        # needs one — us). The check uses the round's own `infos`
+        # (gathered before any push converged heads); once blacklisted
+        # a reqid stays phantom until a real re-execution clears it,
+        # because later rounds' heads are convergence, not content.
+        # A head alone is NOT coverage: convergence moves heads over
+        # skipped-unfound gaps, and those gaps survive flaps in each
+        # member's persistent missing set — a member missing the
+        # entry's object holds its log position, not its cells, and
+        # counting it would fabricate an ack for a write that can
+        # never decode (thrash-found acked-write loss surviving the
+        # in-memory phantom blacklist via a primary change).
+        cover = [(i.last_update, i.missing) for i in infos.values()]
+        kneed = osd.codec_for(self.pool).k if self.is_ec else 1
         for e in self.log.entries[-512:]:
-            if e.reqid[0]:
-                self._req_replies.setdefault(
-                    (e.reqid[0], e.reqid[1]),
-                    M.MOSDOpReply(tid=e.reqid[1], result=M.OK, data=b"",
-                                  size=0, outs=[(0, b"")],
-                                  epoch=osd.osdmap.epoch))
+            if not e.reqid[0]:
+                continue
+            key = (e.reqid[0], e.reqid[1])
+            if sum(1 for h, miss in cover
+                   if h >= e.version and e.oid not in miss) < kneed:
+                # re-insert at the tail: a round that still can't cover
+                # the entry refreshes its recency against the cap
+                self._phantom_reqids.pop(key, None)
+                self._phantom_reqids[key] = None
+                continue
+            if key in self._phantom_reqids:
+                continue
+            self._req_replies.setdefault(
+                key,
+                M.MOSDOpReply(tid=e.reqid[1], result=M.OK, data=b"",
+                              size=0, outs=[(0, b"")],
+                              epoch=osd.osdmap.epoch))
+        while len(self._phantom_reqids) > 1024:
+            del self._phantom_reqids[next(iter(self._phantom_reqids))]
         while len(self._req_replies) > 512:
             self._req_replies.popitem(last=False)
         osd.kick_pg_snap_trim(self)  # new primary: catch up on removals
@@ -2124,9 +2622,23 @@ class PG:
                     try:
                         if v == ZERO and not self.osd.store.exists(
                                 self.cid, oid):
-                            # deleted while migrating: propagate the
-                            # delete (a stale content push must not
-                            # resurrect it)
+                            # absent locally: propagate a delete ONLY
+                            # with log evidence. "I don't hold it" is
+                            # NOT "it was deleted" — a flap-back remap
+                            # can make the pinned primary's own shard a
+                            # hole awaiting recovery while the extras
+                            # still hold the only live chunks, and an
+                            # unfounded OP_DELETE push would destroy
+                            # them (thrash-found data loss). A deleted
+                            # object whose entry outlived the log trim
+                            # still propagates; older ambiguity is left
+                            # to scrub rather than resolved by erasure.
+                            ent = next(
+                                (e for e in reversed(self.log.entries)
+                                 if e.oid == oid), None)
+                            if ent is None or ent.op != OP_DELETE:
+                                skipped.add(oid)
+                                continue
                             ok = True
                             for o, s in extras:
                                 ok &= await self._push_object(
@@ -2239,12 +2751,15 @@ class PG:
                 if e.op != OP_DELETE
             }
             for oid, e in missing.items():
-                if e.op == OP_DELETE and osd.store.exists(self.cid, oid):
-                    t2 = tx.Transaction()
-                    t2.remove(self.cid, oid)
-                    osd.store.queue_transaction(t2)
+                if e.op == OP_DELETE:
+                    self.missing.pop(oid, None)
+                    if osd.store.exists(self.cid, oid):
+                        t2 = tx.Transaction()
+                        t2.remove(self.cid, oid)
+                        osd.store.queue_transaction(t2)
         for oid, version in todo.items():
             if self._object_version(oid) == version:
+                self.missing.pop(oid, None)
                 continue
             if self._subop_misdirected(oid):
                 continue  # split stray: belongs to a child PG now
@@ -2254,7 +2769,12 @@ class PG:
                 except RuntimeError:
                     # unreconstructable (bounced degraded write that
                     # never reached k shards): skip, don't wedge
-                    # peering (unfound-object role)
+                    # peering (unfound-object role) — but RECORD the
+                    # gap: we are about to adopt a log that claims
+                    # this version, and our info must not later count
+                    # as content-coverage for it (fabricated-ack
+                    # guard)
+                    self.missing[oid] = version
                     osd.perf.inc("recovery_unfound")
                     osd.log_exc(f"pg {self.pgid} unfound {oid!r}")
             else:
@@ -2265,11 +2785,13 @@ class PG:
                             epoch=osd.osdmap.epoch),
                 )
                 await asyncio.wait_for(fut, osd.subop_timeout)
-        # every object landed: NOW the authoritative log is ours
+        # every object landed (or was recorded missing): NOW the
+        # authoritative log is ours
         self.log = best.log
         t = tx.Transaction()
         self._ensure_coll(t)
         self._persist_log(t)
+        self._persist_missing(t)
         osd.store.queue_transaction(t)
 
     async def _recover_own_chunk(self, oid: bytes,
@@ -2285,14 +2807,49 @@ class PG:
             t.truncate(self.cid, oid, 0)
             t.write(self.cid, oid, 0, chunk)
             # wipe first: attrs the survivors DON'T have (stale ss / wh
-            # from our pre-crash copy) must not outlive recovery
+            # from our pre-crash copy) must not outlive recovery. The
+            # reconstruct's own ATTR_V wins over the caller's target —
+            # a group-fallback rebuild one generation behind the log
+            # must be LABELED behind, or reads would mix generations
             t.rmattrs(self.cid, oid)
-            t.setattrs(self.cid, oid, {**attrs, ATTR_V: enc_ver(version)})
+            t.setattrs(self.cid, oid, {ATTR_V: enc_ver(version), **attrs})
+            mver = self.missing.get(oid)
+            if mver is not None:
+                got = (dec_ver(attrs[ATTR_V]) if ATTR_V in attrs
+                       else tuple(version))
+                if got >= tuple(mver):
+                    # the rebuild actually covers the recorded gap (a
+                    # group-fallback one generation BEHIND it does not)
+                    self.missing.pop(oid, None)
+                    self._persist_missing(t)
             self.osd.store.queue_transaction(t)
 
-    async def _backfill_peer(self, o: int, s: int) -> None:
+    async def _retry_peer_missing(self, o: int, s: int, info: PGInfo,
+                                  exclude: dict | None = None) -> None:
+        """Push-retry the content gaps a peer has on record — objects
+        BEHIND its converged head, invisible to missing_after — in
+        case strays or revived members make the reconstruct succeed
+        now. A still-unfound object just stays on the peer's record
+        (where it keeps blocking ack fabrication); nothing here wedges
+        the peering round."""
+        for moid, mver in info.missing.items():
+            if exclude and moid in exclude:
+                continue  # skipped this very round: would fail again
+            if self._subop_misdirected(moid):
+                continue
+            try:
+                await self._push_object(
+                    o, s, moid, Entry(OP_MODIFY, moid, tuple(mver)))
+            except RuntimeError:
+                continue
+
+    async def _backfill_peer(self, o: int, s: int
+                             ) -> dict[bytes, tuple[int, int]]:
         """Push every object to a peer whose log diverged past our tail
-        (recover_backfill role — full rescan instead of log delta)."""
+        (recover_backfill role — full rescan instead of log delta).
+        Returns the oids skipped as unfound (the caller ships them with
+        the head push — see _do_peering)."""
+        skipped: dict[bytes, tuple[int, int]] = {}
         for oid in self.osd.store.list_objects(self.cid):
             if oid == META_OID or self._subop_misdirected(oid):
                 continue
@@ -2301,26 +2858,38 @@ class PG:
                 await self._push_object(o, s, oid,
                                         Entry(OP_MODIFY, oid, v))
             except RuntimeError:
+                skipped[oid] = v
                 self.osd.perf.inc("recovery_unfound")
                 self.osd.log_exc(f"pg {self.pgid} unfound {oid!r}")
-        await self._push_log_head(o, s)  # see _do_peering
+        return skipped
 
-    async def _push_log_head(self, o: int, s: int) -> None:
+    async def _push_log_head(self, o: int, s: int,
+                             skipped: dict | None = None) -> None:
         """Ship ONLY our log position to a peer (a content-free delete
         push of an empty oid): handle_push adopts last_update, so the
-        peer's head converges even when every object push was skipped."""
+        peer's head converges even when every object push was skipped.
+        ``skipped`` (oid -> version) names the content gaps this
+        convergence papers over; the peer persists them in its missing
+        set so its info never claims content-coverage for them."""
+        attrs = {"_missing": enc_missing(skipped)} if skipped else {}
         try:
             await self._push_object(o, s, b"",
-                                    Entry(OP_DELETE, b"", self.log.head))
+                                    Entry(OP_DELETE, b"", self.log.head),
+                                    extra_attrs=attrs)
         except Exception:
             pass  # best-effort; the next round retries
 
     async def _push_object(self, o: int, s: int, oid: bytes,
-                           e: Entry, force: bool = True) -> bool:
+                           e: Entry, force: bool = True,
+                           expect: tuple = UNCOND,
+                           extra_attrs: dict | None = None) -> bool:
         """Push one object (or its EC chunk) to member (o, shard s).
         Returns True iff the peer acked — callers that gate delta
         dual-writes on a complete base (pg_temp migration) must treat
-        a timeout as not-pushed."""
+        a timeout as not-pushed. ``expect`` (repair pushes) installs
+        only while the receiver's copy is still at that version — see
+        MPushOp.expect. ``extra_attrs`` ride the message for control
+        payloads (the head push's ``_missing`` set)."""
         osd = self.osd
         if e.op == OP_DELETE:
             data, attrs = None, {}
@@ -2347,89 +2916,243 @@ class PG:
                 raise RuntimeError(
                     f"unreadable local copy of {oid!r}") from None
         osd.perf.inc("recovery_pushes")
-        fut = osd.expect_reply(("pushr", self.pgid, s, oid, o))
+        version = e.version
+        if data is not None and ATTR_V in attrs:
+            # label the push with the generation the content actually
+            # is: a group-fallback reconstruct may rebuild one behind
+            # the log head, and a lying label would let later reads
+            # mix generations (the client's retry catches content up)
+            version = dec_ver(attrs[ATTR_V])
+        tid = osd.new_subtid()
+        key = ("pushr", self.pgid, s, oid, o, tid)
+        fut = osd.expect_reply(key)
         await osd.send(
             f"osd.{o}",
             M.MPushOp(pgid=self.pgid, shard=s, oid=oid,
-                      version=e.version, data=data or b"",
-                      attrs=attrs if data is not None else
-                      {"_deleted": b"1"},
+                      version=version, data=data or b"",
+                      attrs={**(attrs if data is not None else
+                                {"_deleted": b"1"}),
+                             **(extra_attrs or {})},
                       epoch=osd.osdmap.epoch, force=int(force),
-                      last_update=self.log.head),
+                      last_update=self.log.head, tid=tid,
+                      expect=expect),
         )
         try:
             await asyncio.wait_for(fut, osd.subop_timeout)
             return True
         except asyncio.TimeoutError:
-            osd.drop_reply(("pushr", self.pgid, s, oid, o))
+            osd.drop_reply(key)
             return False
+
+    async def _fetch_shard_copy(self, oid: bytes, j: int,
+                                live: dict[int, int], vers: dict,
+                                size_attrs: dict, attrs_by: dict):
+        """Whole-file, hinfo-verified fetch of shard position ``j``
+        from its live holder; records version/size/recovery-attrs and
+        returns the chunk bytes, or None when unreadable/absent.
+        Local reads pass through the ``ec_read_bitflip`` fault site,
+        and a failed hinfo check counts as ``ec_read_crc_err``."""
+        target = live.get(j)
+        if target is None:
+            return None
+        cidj = self._shard_cid(j)
+        if target == self.osd.id:
+            try:
+                chunk = bytes(self.osd.store.read(cidj, oid))
+                chunk = self._maybe_bitflip(chunk, oid, j)
+                self._verify_hinfo(cidj, oid, chunk)
+                vers[j] = self._shard_obj_version(cidj, oid)
+                size_attrs[j] = self.osd.store.getattr(cidj, oid,
+                                                       ATTR_SIZE)
+                attrs_by[j] = {
+                    k: v
+                    for k, v in self.osd.store.getattrs(cidj,
+                                                        oid).items()
+                    if _is_recovery_attr(k)
+                }
+                return chunk
+            except HinfoError:
+                self.osd.perf.inc("ec_read_crc_err")
+                return None
+            except Exception:
+                return None
+        subtid = self.osd.new_subtid()
+        fut = self.osd.expect_reply(subtid)
+        try:
+            await self.osd.send(
+                f"osd.{target}",
+                M.MECSubRead(tid=subtid, pgid=self.pgid, shard=j,
+                             oid=oid, offset=0, length=-1,
+                             trace=_trace_ctx()),
+            )
+            reply = await self.osd.await_reply(subtid, fut, target)
+        except Exception:
+            # transport failure (peer flapping, send raced a kill) is
+            # TRANSIENT: re-raise after cleanup so callers retry the
+            # round — swallowing it here would make the shard look
+            # unreadable and let recovery misclassify a reachable
+            # object as unfound debris (and converge log heads over
+            # the gap: acked-write loss)
+            self.osd.drop_reply(subtid)
+            raise
+        if reply.result != M.OK:
+            return None
+        vers[j] = tuple(reply.ver)
+        size_attrs[j] = denc.enc_u64(reply.size)
+        attrs_by[j] = dict(reply.attrs)
+        return reply.data
+
+    async def _collect_stray_copies(self, oid: bytes,
+                                    live: dict[int, int]) -> list:
+        """Probe every up OSD for stray shard copies of ``oid`` left by
+        prior-interval placements (might_have_unfound role). Current
+        holders are skipped (the caller already fetched them). Returns
+        [(ver, pos, chunk, size_attr, attrs)] hinfo-verified; probing
+        an OSD that never held the shard is cheap (ENOENT)."""
+        codec = self.osd.codec_for(self.pool)
+        osdmap = self.osd.osdmap
+
+        async def _probe(pos: int, o: int):
+            tmp_v: dict = {}
+            tmp_s: dict = {}
+            tmp_a: dict = {}
+            try:
+                got = await self._fetch_shard_copy(
+                    oid, pos, {pos: o}, tmp_v, tmp_s, tmp_a)
+            except Exception:
+                got = None  # transient peer failure: best-effort
+            if got is None or tmp_v.get(pos, ZERO) == ZERO:
+                return None
+            return (tmp_v[pos], pos, got, tmp_s.get(pos),
+                    tmp_a.get(pos, {}))
+
+        # all probes fly CONCURRENTLY: callers hold the PG lock across
+        # the sweep, and chunk_count x n_osds serial round-trips (each
+        # up to a subop timeout when a peer dies mid-probe) would stall
+        # every client op on the PG; one concurrent round bounds the
+        # sweep at a single round-trip/timeout. Result order stays the
+        # deterministic (pos, osd) iteration order.
+        probes = [_probe(pos, o)
+                  for pos in range(codec.get_chunk_count())
+                  for o in range(osdmap.n_osds)
+                  if osdmap.is_up(o) and o != live.get(pos)]
+        found = await asyncio.gather(*probes)
+        out = [f for f in found if f is not None]
+        if out:
+            self.osd.perf.inc("ec_stray_reads", len(out))
+        return out
 
     async def _reconstruct_chunk(self, oid: bytes, shard: int):
         """Rebuild shard `shard`'s chunk from k survivors (the recovery
         read-reconstruct path, ECBackend continue_recovery_op role).
         Unreadable survivors (EIO, bit rot failing their hinfo) are
-        excluded and the fetch set re-planned, like _read_ec."""
+        excluded and the fetch set re-planned, like _read_ec — and so
+        are version-lagging survivors (ATTR_V cross-check): a rebuild
+        mixing a revived stale shard's cells with current ones would
+        PERSIST wrong bytes under fresh self-consistent CRCs. The
+        returned attrs carry the size/recovery attrs AND the ATTR_V of
+        the (max-version) generation the rebuild represents."""
         codec = self.osd.codec_for(self.pool)
         live = {s: o for o, s in self.live_members()}
         chunks: dict[int, bytes] = {}
+        demoted: dict[int, bytes] = {}  # kept for the group fallback
+        vers: dict[int, tuple[int, int]] = {}
+        size_attrs: dict[int, bytes] = {}
+        attrs_by: dict[int, dict[str, bytes]] = {}
         failed: set[int] = {shard}
-        size_attr = None
-        remote_size = None
-        user_attrs: dict[str, bytes] = {}
+        tried_self = False
+        tried_strays = False
         while True:
             usable = [s for s in sorted(live) if s not in failed]
             try:
                 need = codec.minimum_to_decode([shard], usable)
             except Exception:
+                # newest generation can't reach k members (interrupted
+                # fan-out): rebuild the newest generation that can —
+                # see _best_version_group; the retry re-applies the
+                # unacked write on top. The TARGET's own stored copy
+                # (hinfo-verified) joins the candidate pool here: when
+                # the target already holds the authoritative older
+                # generation, it completes that group (the scrub-
+                # rollback arc needs exactly this).
+                if not tried_self:
+                    tried_self = True
+                    try:
+                        got = await self._fetch_shard_copy(
+                            oid, shard, live, vers, size_attrs,
+                            attrs_by)
+                    except Exception:
+                        got = None  # best-effort last-ditch candidate
+                    if got is not None:
+                        demoted[shard] = got
+                if not tried_strays:
+                    # prior-interval STRAY copies (might_have_unfound
+                    # role): shard positions remapped during flaps
+                    # leave acked chunks in old holders' stores, so
+                    # the current up set alone can hold an acked
+                    # generation below k — and scrub would roll it
+                    # back as orphan debris (acked-write loss). Probe
+                    # every up OSD's store before giving that
+                    # generation up.
+                    tried_strays = True
+                    stray = await self._collect_stray_copies(oid, live)
+                    if stray:
+                        pool = [(vers.get(p, ZERO), p, c,
+                                 size_attrs.get(p), attrs_by.get(p, {}))
+                                for p, c in {**demoted,
+                                             **chunks}.items()]
+                        gen = _assemble_generation(pool + stray,
+                                                   codec.k)
+                        if gen is not None:
+                            chunks, vers, size_attrs, attrs_by = gen
+                            break
+                fb = _best_version_group({**demoted, **chunks},
+                                         vers, codec.k)
+                if fb is not None:
+                    chunks = fb
+                    break
                 raise RuntimeError(
                     f"cannot reconstruct shard {shard} of {oid!r}: "
                     f"unreadable {sorted(failed - {shard})}"
                 )
-            progress = False
             for j in sorted(need):
                 if j in chunks:
                     continue
-                target = live[j]
-                cidj = f"{self.pgid[0]}.{self.pgid[1]}s{j}"
-                if target == self.osd.id:
-                    try:
-                        chunk = bytes(self.osd.store.read(cidj, oid))
-                        self._verify_hinfo(cidj, oid, chunk)
-                        chunks[j] = chunk
-                        size_attr = self.osd.store.getattr(
-                            cidj, oid, ATTR_SIZE
-                        )
-                        user_attrs.update({
-                            k: v for k, v in self.osd.store.getattrs(
-                                cidj, oid
-                            ).items() if _is_recovery_attr(k)
-                        })
-                        progress = True
-                    except Exception:
+                got = await self._fetch_shard_copy(
+                    oid, j, live, vers, size_attrs, attrs_by)
+                if got is None:
+                    failed.add(j)
+                else:
+                    chunks[j] = got
+            if not all(j in chunks for j in need):
+                continue  # re-plan with the enlarged failed set
+            if self._ec_version_check and vers:
+                vmax = max(vers.get(j, ZERO) for j in chunks)
+                stale = [j for j in chunks if vers.get(j, ZERO) < vmax]
+                if stale:
+                    for j in stale:
+                        demoted[j] = chunks.pop(j)
                         failed.add(j)
                     continue
-                subtid = self.osd.new_subtid()
-                fut = self.osd.expect_reply(subtid)
-                await self.osd.send(
-                    f"osd.{target}",
-                    M.MECSubRead(tid=subtid, pgid=self.pgid, shard=j,
-                                 oid=oid, offset=0, length=-1,
-                                 trace=_trace_ctx()),
-                )
-                reply = await self.osd.await_reply(subtid, fut, target)
-                if reply.result == M.OK:
-                    chunks[j] = reply.data
-                    remote_size = reply.size
-                    user_attrs.update(reply.attrs)
-                    progress = True
-                else:
-                    failed.add(j)
-            if all(j in chunks for j in need):
-                break
-            if not progress:
-                continue  # re-plan with the enlarged failed set
+            break
+        sel_ver = max((vers.get(j, ZERO) for j in chunks), default=ZERO)
+        for j in demoted:
+            if j not in chunks and vers.get(j, ZERO) < sel_ver:
+                self.osd.perf.inc("ec_read_stale_shard")
+        # size/attrs must come from the generation being rebuilt: the
+        # max-version contributor (union keeps shard-invariant extras,
+        # the best shard's values win conflicts)
+        best = max(chunks, key=lambda j: vers.get(j, ZERO)) \
+            if chunks else None
+        size_attr = size_attrs.get(best)
         if size_attr is None:
-            size_attr = denc.enc_u64(remote_size or 0)
+            size_attr = next(iter(size_attrs.values()),
+                             denc.enc_u64(0))
+        user_attrs: dict[str, bytes] = {}
+        for j in sorted((j for j in attrs_by if j in chunks),
+                        key=lambda j: vers.get(j, ZERO)):
+            user_attrs.update(attrs_by[j])
+        vbest = vers.get(best, ZERO) if best is not None else ZERO
         maxlen = max(len(c) for c in chunks.values()) if chunks else 0
         si = self.osd.sinfo_for(self.pool)
         # batched rebuild through the ECBatcher (one stacked-matrix
@@ -2440,7 +3163,7 @@ class PG:
         rebuilt = await self._decode_cells_batched(
             codec, si, chunks, maxlen, want_generators=(g,))
         chunk = rebuilt[:, 0, :].reshape(-1)[:maxlen].tobytes()
-        return chunk, {
+        out_attrs = {
             **user_attrs,
             ATTR_SIZE: size_attr,
             ATTR_HINFO: st.enc_hinfo(
@@ -2449,11 +3172,16 @@ class PG:
                 )
             ),
         }
+        if vbest != ZERO:
+            # the generation this rebuild represents; callers that know
+            # a newer authoritative version override it
+            out_attrs[ATTR_V] = enc_ver(vbest)
+        return chunk, out_attrs
 
     # ---------------------------------------------- peering-side handlers
 
     async def handle_info_req(self, src: str, m: M.MPGInfoReq) -> None:
-        info = PGInfo(self.log.head, self.log)
+        info = PGInfo(self.log.head, self.log, dict(self.missing))
         await self.osd.send(
             src,
             M.MPGInfoReply(pgid=self.pgid, epoch=self.osd.epoch,
@@ -2603,25 +3331,52 @@ class PG:
     async def _scrub_repair_ec(self, oid, maps, bad):
         """EC scrub: a member is divergent when its version lags, its
         chunk fails its own hinfo (bit rot), or the chunk is missing;
-        repair = reconstruct that shard from survivors and push."""
+        repair = reconstruct that shard from survivors and push.
+
+        The reconstruct may legitimately come back BEHIND ``newest``
+        (group fallback): a write fan-out that died mid-flight leaves
+        a < k minority one generation ahead — never ack-able, so the
+        decodable generation is authoritative and the orphans ROLL
+        BACK to it (the divergent-entry rollback of the reference's
+        merge_log). The push's expect-CAS makes that rollback land
+        only on the exact orphan version scrub observed — a racing
+        client write wins. Unreconstructable objects are counted
+        unfound and skipped, never allowed to wedge the scrub."""
         copies = {key: m_[oid] for key, m_ in maps.items() if oid in m_}
         newest = max(v for v, _ in copies.values())
+        # authoritative generation = the newest one that can DECODE
+        # (>= k healthy members); a < k orphan generation was never
+        # ack-able and rolls back rather than dragging the PG after it
+        k = self.osd.codec_for(self.pool).k
+        vcount: dict = {}
+        for key, (v, _dig) in copies.items():
+            if oid not in bad[key]:
+                vcount[v] = vcount.get(v, 0) + 1
+        decodable = [v for v, n in vcount.items() if n >= k]
+        target = max(decodable) if decodable else newest
         divergent = []
         for key, m_ in maps.items():
             ent = m_.get(oid)
-            if ent is None or ent[0] != newest or oid in bad[key]:
+            if ent is None or ent[0] != target or oid in bad[key]:
                 divergent.append(key)
         if not divergent:
             return None
         me = (self.osd.id, self.shard)
         repaired = []
         for o, s in divergent:
-            if (o, s) == me:
-                await self._recover_own_chunk(oid, newest)
-            else:
-                await self._push_object(
-                    o, s, oid, Entry(OP_MODIFY, oid, newest)
-                )
+            ent = maps[(o, s)].get(oid)
+            expect = ent[0] if ent is not None else ZERO
+            try:
+                if (o, s) == me:
+                    await self._recover_own_chunk(oid, target)
+                else:
+                    await self._push_object(
+                        o, s, oid, Entry(OP_MODIFY, oid, target),
+                        expect=expect,
+                    )
+            except RuntimeError:
+                self.osd.perf.inc("recovery_unfound")
+                continue
             repaired.append((o, s))
         return repaired
 
@@ -2723,22 +3478,75 @@ class PG:
         older than our local copy is skipped — during a pg_temp
         migration a dual-committed write may land before the migration
         push of the same object, and the stale push must not win."""
+        cur = (self._object_version(m.oid)
+               if self.osd.store.exists(self.cid, m.oid) else ZERO)
         if (not m.force
                 and not m.attrs.get("_deleted")
-                and self.osd.store.exists(self.cid, m.oid)
-                and self._object_version(m.oid) >= m.version
-                and self._object_version(m.oid) != ZERO):
+                and cur != ZERO
+                and cur >= m.version):
+            mver = self.missing.get(m.oid)
+            if mver is not None and cur >= mver:
+                # our copy already covers the recorded gap (a full
+                # rewrite landed between the mark and this push)
+                self.missing.pop(m.oid)
+                t0 = tx.Transaction()
+                self._persist_missing(t0)
+                self.osd.store.queue_transaction(t0)
             await self.osd.send(
                 src,
                 M.MPushReply(pgid=self.pgid, shard=m.shard, oid=m.oid,
-                             result=M.OK),
+                             result=M.OK, tid=m.tid),
+            )
+            return
+        if (m.force
+                and tuple(m.expect) != UNCOND
+                and not m.attrs.get("_deleted")
+                and cur != tuple(m.expect)):
+            # repair CAS miss: the repairer reconstructed against a
+            # copy at m.expect, but the copy moved while the push was
+            # in flight (its send happens outside the PG lock — a
+            # racing client write must win). Covers every direction:
+            # a stale repair never regresses a newer write, a
+            # deliberate rollback of unacked-fanout debris only lands
+            # on the exact orphan version it targeted, and a copy
+            # deleted mid-flight (cur == ZERO, expect != ZERO) stays
+            # deleted instead of being resurrected as orphan debris.
+            await self.osd.send(
+                src,
+                M.MPushReply(pgid=self.pgid, shard=m.shard, oid=m.oid,
+                             result=M.OK, tid=m.tid),
             )
             return
         t = tx.Transaction()
         self._ensure_coll(t)
+        miss_dirty = False
         if m.attrs.get("_deleted"):
             if self.osd.store.exists(self.cid, m.oid):
                 t.remove(self.cid, m.oid)
+            # a deleted object has no content to be missing; a HEAD
+            # push (empty oid) instead carries the pusher's skipped-
+            # unfound set — the gaps its head convergence papers over.
+            # They go in OUR missing set so this member's info never
+            # claims content-coverage it does not have.
+            miss_dirty = self.missing.pop(m.oid, None) is not None
+            raw_missing = m.attrs.get("_missing")
+            if m.oid == b"" and raw_missing:
+                gaps, _ = dec_missing(raw_missing)
+                for goid, gver in gaps.items():
+                    gver = tuple(gver)
+                    have = (self._object_version(goid)
+                            if self.osd.store.exists(self.cid, goid)
+                            else ZERO)
+                    # only ever RAISE the recorded gap: an older
+                    # pusher's smaller gver must not demote a newer
+                    # recorded gap, or a mid-version push would clear
+                    # it and this member's info would claim content-
+                    # coverage for the newest gap again
+                    if (have < gver
+                            and gver > tuple(self.missing.get(goid,
+                                                              ZERO))):
+                        self.missing[goid] = gver
+                        miss_dirty = True
         else:
             t.truncate(self.cid, m.oid, 0)
             t.write(self.cid, m.oid, 0, m.data)
@@ -2748,15 +3556,24 @@ class PG:
             t.rmattrs(self.cid, m.oid)
             t.setattrs(self.cid, m.oid,
                        {**m.attrs, ATTR_V: enc_ver(m.version)})
+            # content landed: the gap is filled IF the push actually
+            # covers it (a fallback-labeled push one generation behind
+            # the recorded gap leaves it on record)
+            mver = self.missing.get(m.oid)
+            if mver is not None and tuple(m.version) >= tuple(mver):
+                self.missing.pop(m.oid)
+                miss_dirty = True
         if m.last_update > self.log.head:
             # pushes carry the pusher's log point; adopting it keeps a
             # revived replica's next peering round delta-shaped
             self.log.tail = m.last_update
             self.log.entries = []
         self._persist_log(t)
+        if miss_dirty:
+            self._persist_missing(t)
         self.osd.store.queue_transaction(t)
         await self.osd.send(
             src,
             M.MPushReply(pgid=self.pgid, shard=m.shard, oid=m.oid,
-                         result=M.OK),
+                         result=M.OK, tid=m.tid),
         )

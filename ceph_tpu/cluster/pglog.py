@@ -141,19 +141,49 @@ class PGLog:
         return cls((te, ts), entries), off
 
 
+def enc_missing(d: dict[bytes, tuple[int, int]]) -> bytes:
+    """Encode a missing-set: oid -> newest version whose CONTENT this
+    member lacks even though its log/head claims it (pg_missing_t
+    role)."""
+    out = [denc.enc_u32(len(d))]
+    for oid, (e, s) in sorted(d.items()):
+        out.append(denc.enc_bytes(oid))
+        out.append(denc.enc_u32(e))
+        out.append(denc.enc_u64(s))
+    return b"".join(out)
+
+
+def dec_missing(buf: bytes, off: int = 0
+                ) -> tuple[dict[bytes, tuple[int, int]], int]:
+    n, off = denc.dec_u32(buf, off)
+    d: dict[bytes, tuple[int, int]] = {}
+    for _ in range(n):
+        oid, off = denc.dec_bytes(buf, off)
+        e, off = denc.dec_u32(buf, off)
+        s, off = denc.dec_u64(buf, off)
+        d[oid] = (e, s)
+    return d, off
+
+
 @dataclass
 class PGInfo:
     """What peering exchanges (pg_info_t role): where a member's copy
-    stands, plus its log for authoritative selection."""
+    stands, plus its log for authoritative selection and its missing
+    set — objects whose content never landed despite the log position
+    (head convergence over skipped unfound pushes, adopted logs whose
+    reconstruct failed). The missing set is what keeps the reply-cache
+    rebuild honest: a converged HEAD is not evidence of CONTENT."""
 
     last_update: tuple[int, int] = ZERO
     log: PGLog = field(default_factory=PGLog)
+    missing: dict[bytes, tuple[int, int]] = field(default_factory=dict)
 
     def encode(self) -> bytes:
         return (
             denc.enc_u32(self.last_update[0])
             + denc.enc_u64(self.last_update[1])
             + self.log.encode()
+            + enc_missing(self.missing)
         )
 
     @classmethod
@@ -161,4 +191,5 @@ class PGInfo:
         e, off = denc.dec_u32(buf, off)
         s, off = denc.dec_u64(buf, off)
         log, off = PGLog.decode(buf, off)
-        return cls((e, s), log), off
+        missing, off = dec_missing(buf, off)
+        return cls((e, s), log, missing), off

@@ -134,8 +134,29 @@ class ProcCluster:
                           secure=self.secure)
         await self.bus.start()
         self.bus.register("mgr", self._mgr_sink)
-        self.client = RadosClient(self.bus)
+        # boot-generous op deadline: connect()'s first-osdmap wait and
+        # the caller's first mon ops race freshly spawned mon processes
+        # through their first election — on a loaded box 10 s starves
+        # (the tick-resend cap keeps retry latency bounded regardless)
+        self.client = RadosClient(self.bus, op_timeout=30.0)
         await self.client.connect()
+        if self.n_mons > 1:
+            # hand back a FORMED quorum: mon processes race their first
+            # election (a loaded box can starve one mon's ack past the
+            # round), and a caller's immediate mon op would otherwise
+            # burn its whole retry budget on the churn of the rejoin
+            # elections. Best-effort deadline — a genuinely degraded
+            # quorum still comes up, just not waited for.
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                try:
+                    _rc, _outs, outb = await self.client.mon_command(
+                        ["quorum_status"])
+                    if len(json.loads(outb)["quorum"]) == self.n_mons:
+                        break
+                except (IOError, asyncio.TimeoutError):
+                    pass
+                await asyncio.sleep(0.25)
 
     async def _mgr_sink(self, _src: str, msg) -> None:
         if isinstance(msg, M.MMgrReport):
@@ -180,6 +201,21 @@ class ProcCluster:
     async def revive_osd(self, i: int) -> None:
         self._spawn("osd", i)
         await self._wait_ready("osd", i)
+
+    async def flap_osd(self, i: int, downtime: float = 0.5,
+                       sig: int = signal.SIGKILL) -> None:
+        """Kill -9 + revive in one verb (the process-tier thrasher
+        flap): the revived daemon mounts the same durable store and
+        recovers — mirrors TestCluster.flap_osd so thrash scenarios
+        port between the in-process and process tiers."""
+        self.kill_osd(i, sig)
+        try:
+            await self.wait_down(i, timeout=max(10.0, downtime * 4))
+        except asyncio.TimeoutError:
+            pass  # mon mid-failover may lag; revive regardless
+        if downtime > 0:
+            await asyncio.sleep(downtime)
+        await self.revive_osd(i)
 
     async def start_mds(self, rank: int, pool: int,
                         data_pool: int | None = None) -> None:

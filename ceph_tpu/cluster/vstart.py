@@ -14,6 +14,7 @@ from ..msg.messenger import LocalBus
 from ..placement import crushmap as cm
 from ..store.memstore import MemStore
 from .client import RadosClient
+from .faults import FaultPlane
 from .mgr import MgrLite
 from .mon import MonLite
 from .osd import OSDLite
@@ -37,10 +38,20 @@ class TestCluster:
                  crush: cm.CrushMap | None = None, n_mons: int = 1,
                  objectstore: str = "memstore",
                  data_dir: str | None = None,
-                 osd_conf: dict | None = None, **store_kw):
-        self.bus = LocalBus()
+                 osd_conf: dict | None = None,
+                 faults: FaultPlane | None = None,
+                 fault_seed: int = 0, **store_kw):
+        #: the cluster's fault authority (cluster/faults.py): the bus
+        #: honors its net policy, every (re)started OSD attaches its
+        #: store injector, and the Thrasher drives lifecycle through it
+        self.faults = faults if faults is not None \
+            else FaultPlane(fault_seed)
+        self.bus = LocalBus(faults=self.faults.net)
         self.n_osds = n_osds
         self.n_mons = n_mons
+        self._hb_grace = hb_grace
+        self._out_interval = out_interval
+        self._crush = crush
         #: config overrides applied to every OSD before it boots (the
         #: vstart.sh `-o key=value` role) — e.g. the EC batch
         #: coalescing knobs or osd_op_concurrency
@@ -55,6 +66,7 @@ class TestCluster:
 
             return MonStore(f"{data_dir}/mon.{rank}.kv")
 
+        self._make_mon_store = _mon_store
         if n_mons > 1:
             from .paxos_mon import PaxosMon
 
@@ -124,6 +136,22 @@ class TestCluster:
             await m.stop()
             self.mons[rank] = None
 
+    async def revive_mon(self, rank: int):
+        """Restart a killed quorum mon (mon failover orchestration for
+        the thrasher): the fresh replica rejoins and catches up via the
+        collect round — or from its durable MonStore when one exists."""
+        assert self.n_mons > 1 and self.mons[rank] is None
+        from .paxos_mon import PaxosMon
+
+        m = PaxosMon(self.bus, self.n_osds, rank=rank,
+                     n_mons=self.n_mons, crush=self._crush,
+                     hb_grace=self._hb_grace,
+                     out_interval=self._out_interval,
+                     store=self._make_mon_store(rank))
+        self.mons[rank] = m
+        await m.start()
+        return m
+
     async def stop(self) -> None:
         try:
             await self.client.close()
@@ -151,6 +179,7 @@ class TestCluster:
         osd = OSDLite(self.bus, i, store=self.stores[i],
                       hb_interval=self.hb_interval, conf=conf)
         self.osds[i] = osd
+        self.faults.attach_osd(osd)
         await osd.start()
         return osd
 
@@ -164,6 +193,19 @@ class TestCluster:
 
     async def revive_osd(self, i: int) -> OSDLite:
         return await self.start_osd(i)
+
+    async def flap_osd(self, i: int, downtime: float = 0.5) -> OSDLite:
+        """Kill + revive in one verb (the thrasher's flap): crash-stop,
+        wait the mon's failure detection out, revive onto the same
+        store (a restarted daemon finding its data on disk)."""
+        await self.kill_osd(i)
+        try:
+            await self.wait_down(i, timeout=max(10.0, downtime * 4))
+        except asyncio.TimeoutError:
+            pass  # partitioned mon may lag; revive regardless
+        if downtime > 0:
+            await asyncio.sleep(downtime)
+        return await self.revive_osd(i)
 
     async def wait_epoch(self, epoch: int, timeout: float = 10.0) -> None:
         """Block until the mon map reaches `epoch`."""

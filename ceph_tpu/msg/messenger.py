@@ -66,20 +66,36 @@ class LocalBus:
     (TcpMessenger, NetBus) marshal everything, always.
     """
 
-    def __init__(self) -> None:
+    #: drop-record retention: under a long thrash a partition drops
+    #: thousands of messages (each holding live payload objects) — the
+    #: record is a debugging aid, not a ledger, so it stays bounded
+    MAX_DROPPED = 512
+
+    def __init__(self, faults=None) -> None:
         self.entities: dict[str, Dispatcher] = {}
         self.dropped: list[tuple[str, str, Message]] = []
-        #: test hook: set of entity names that silently drop traffic
-        #: (blackhole_kill_osd analog, qa/tasks/ceph_manager.py:537)
-        self.blackholes: set[str] = set()
+        # the fault policy (cluster/faults.NetFaultPolicy): every send
+        # consults it for drop/partition/delay/duplicate. The old
+        # ad-hoc blackhole set lives INSIDE the policy now; the
+        # `blackholes` property below keeps the historical test verb
+        # (blackhole_kill_osd analog, qa/tasks/ceph_manager.py:537).
+        if faults is None:
+            from ..cluster.faults import NetFaultPolicy
+
+            faults = NetFaultPolicy()
+        self.faults = faults
         self._tasks: set[asyncio.Task] = set()
+
+    @property
+    def blackholes(self) -> set[str]:
+        return self.faults.blackholes
 
     def register(self, name: str, dispatcher: Dispatcher) -> None:
         self.entities[name] = dispatcher
 
     def unregister(self, name: str) -> None:
         self.entities.pop(name, None)
-        self.blackholes.discard(name)
+        self.faults.blackholes.discard(name)
 
     async def send(self, src: str, dst: str, msg: Message) -> None:
         if not ZERO_COPY_TYPES:
@@ -89,8 +105,11 @@ class LocalBus:
         else:
             decoded = decode_message(msg.TYPE, msg.encode())
         sender = src
-        if dst in self.blackholes or src in self.blackholes:
+        plan = self.faults.plan(src, dst)
+        if plan is None:
             self.dropped.append((src, dst, decoded))
+            if len(self.dropped) > self.MAX_DROPPED:
+                del self.dropped[: -self.MAX_DROPPED]
             return
         handler = self.entities.get(dst)
         if handler is None:
@@ -98,9 +117,24 @@ class LocalBus:
         # schedule, do not inline: senders never re-enter their own state
         # under a peer's stack frame (the reference's fast_dispatch re-
         # entrancy rules exist to manage exactly that)
-        task = asyncio.get_running_loop().create_task(handler(sender, decoded))
-        self._tasks.add(task)
-        task.add_done_callback(self._tasks.discard)
+        for i, delay in enumerate(plan):
+            if i and msg.TYPE not in ZERO_COPY_TYPES:
+                # duplicates get their own decode: two deliveries must
+                # never share one mutable message object
+                decoded = decode_message(msg.TYPE, msg.encode())
+            coro = (handler(sender, decoded) if delay <= 0 else
+                    self._deliver_later(delay, handler, sender, decoded))
+            task = asyncio.get_running_loop().create_task(coro)
+            self._tasks.add(task)
+            task.add_done_callback(self._tasks.discard)
+
+    @staticmethod
+    async def _deliver_later(delay: float, handler: Dispatcher,
+                             sender: str, decoded: Message) -> None:
+        # injected latency/reorder: per-pair FIFO is intentionally
+        # broken here — that is the fault being modeled
+        await asyncio.sleep(delay)
+        await handler(sender, decoded)
 
     async def drain(self) -> None:
         """Wait until every in-flight delivery (and what it spawned) ran."""
@@ -137,9 +171,15 @@ class TcpMessenger:
 
     def __init__(self, name: str, dispatcher: Dispatcher, keys=None,
                  secure: bool = False,
-                 compress_threshold: int | None = None):
+                 compress_threshold: int | None = None, faults=None):
         self.name = name
         self.dispatcher = dispatcher
+        #: optional NetFaultPolicy (cluster/faults.py): outgoing sends
+        #: honor drop/partition/delay/duplicate exactly like LocalBus —
+        #: the same policy object drives both tiers, so a thrash
+        #: scenario scripted against the in-process bus replays
+        #: unchanged over real sockets
+        self.faults = faults
         self.keys = keys  # KeyServer | None
         self.secure = secure
         if secure and keys is None:
@@ -149,6 +189,7 @@ class TcpMessenger:
         self._conns: dict[str, tuple] = {}  # dst -> (writer, auth, sess)
         self._server: asyncio.AbstractServer | None = None
         self._readers: set[asyncio.Task] = set()
+        self._bg: set[asyncio.Task] = set()  # delayed fault deliveries
 
     async def listen(self, host: str = "127.0.0.1", port: int = 0) -> tuple[str, int]:
         self._server = await asyncio.start_server(self._accept, host, port)
@@ -161,6 +202,8 @@ class TcpMessenger:
         # drained FIRST or close deadlocks on any open connection
         if self._server:
             self._server.close()
+        for t in list(self._bg):
+            t.cancel()
         for w, *_rest in self._conns.values():
             w.close()
         self._conns.clear()
@@ -341,6 +384,41 @@ class TcpMessenger:
         return writer, auth, sess
 
     async def send(self, dst: str, msg: Message) -> None:
+        copies = 1
+        if self.faults is not None:
+            plan = self.faults.plan(self.name, dst)
+            if plan is None:
+                return  # dropped on the wire: writes into the void
+            # wire tier applies injected latency sender-side (one
+            # stream, in-order per pair), but NEVER by stalling the
+            # caller — a delay fault models the link, not the sender's
+            # whole pipeline. Delayed deliveries ride a background
+            # task (send errors there have no caller to surface to).
+            delay = max(plan)
+            copies = len(plan)
+            if delay > 0:
+                # snapshot NOW: the sender may retain and mutate the
+                # message (the client's MOSDOp resend path) — the
+                # delayed copy must carry send-time state, like
+                # LocalBus's decode-at-send does
+                snap = decode_message(msg.TYPE, msg.encode())
+                task = asyncio.get_running_loop().create_task(
+                    self._send_delayed(dst, snap, delay, copies))
+                self._bg.add(task)
+                task.add_done_callback(self._bg.discard)
+                return
+        await self._send_now(dst, msg, copies)
+
+    async def _send_delayed(self, dst: str, msg: Message, delay: float,
+                            copies: int) -> None:
+        await asyncio.sleep(delay)
+        try:
+            await self._send_now(dst, msg, copies)
+        except SendError:
+            pass  # the link was faulted anyway; nobody to tell
+
+    async def _send_now(self, dst: str, msg: Message,
+                        copies: int = 1) -> None:
         conn = self._conns.get(dst)
         if conn is None or conn[0].is_closing():
             conn = await self._connect(dst)
@@ -355,14 +433,18 @@ class TcpMessenger:
             packed = zlib.compress(payload, 1)
             if len(packed) < len(payload):
                 payload, flags = packed, self.FLAG_COMPRESSED
-        wire = encode_frame(Frame(msg.TYPE, payload, flags))
-        if sess is not None:
-            wire = sess.encrypt(wire)  # secure mode: GCM supersedes HMAC
-        elif auth is not None:
-            wire += auth.sign(wire)
-        try:
-            writer.write(wire)
-            await writer.drain()
-        except (ConnectionError, OSError) as e:
-            self._conns.pop(dst, None)
-            raise SendError(f"send to {dst} failed: {e}") from e
+        for _copy in range(copies):
+            wire = encode_frame(Frame(msg.TYPE, payload, flags))
+            if sess is not None:
+                # secure mode: GCM supersedes HMAC; each copy gets its
+                # own counter nonce (a byte-identical replayed record
+                # would be rejected as a replay, rightly)
+                wire = sess.encrypt(wire)
+            elif auth is not None:
+                wire += auth.sign(wire)
+            try:
+                writer.write(wire)
+                await writer.drain()
+            except (ConnectionError, OSError) as e:
+                self._conns.pop(dst, None)
+                raise SendError(f"send to {dst} failed: {e}") from e

@@ -210,6 +210,18 @@ SCHEMA = Schema([
                 "mClock queue; >1 lets EC stripes from different ops "
                 "coalesce into one device batch (per-PG write ordering "
                 "is preserved by the PG lock)"),
+    Option("osd_ec_verify_on_read", "bool", True,
+           desc="verify per-cell hinfo CRC32C on EVERY EC read, normal "
+                "or degraded: a mismatch excludes the shard (EIO, "
+                "counter ec_read_crc_err) and kicks a repair instead "
+                "of serving rotted cells; off trades that safety for "
+                "read-path CPU"),
+    Option("client_backoff_base", "secs", 0.05, min=0.001,
+           desc="first retry delay of the client resend loops (ESTALE/"
+                "EAGAIN and tick-resend); doubles per attempt with "
+                "jitter (bounded exponential backoff)"),
+    Option("client_backoff_max", "secs", 2.0, min=0.01,
+           desc="retry delay ceiling of the client resend loops"),
     Option("store_kind", "str", "memstore",
            enum=("memstore", "walstore"), runtime=False,
            desc="ObjectStore backend for OSD-lite daemons"),

@@ -21,6 +21,14 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    # tier-1 runs `-m 'not slow'` (ROADMAP): long thrashes and other
+    # minute-scale scenarios carry @pytest.mark.slow
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running scenario excluded from tier-1 (-m 'not slow')")
+
+
 @pytest.fixture
 def rng():
     return np.random.default_rng(1234)

@@ -364,11 +364,13 @@ def test_osd_df_and_upmap_commands():
             assert rc == 0
             rows = json.loads(outb)
             assert len(rows) == 4
-            # stats flow on the digest tick: poll for nonzero usage
+            # stats flow on the digest tick: poll until EVERY replica's
+            # usage landed (a lone early heartbeat reports a partial
+            # sum that would flake the assertion below)
             for _ in range(60):
                 rc, _, outb = await c.client.mon_command(["osd", "df"])
                 rows = json.loads(outb)
-                if sum(r["used_bytes"] for r in rows) > 0:
+                if sum(r["used_bytes"] for r in rows) >= 4 * 1000:
                     break
                 await asyncio.sleep(0.25)
             assert sum(r["used_bytes"] for r in rows) >= 4 * 1000

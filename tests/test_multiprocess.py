@@ -392,8 +392,10 @@ def test_multiprocess_mon_command(tmp_path):
             # poll the status digest with a deadline: under full-suite
             # load a mon can answer before every peer joined the
             # quorum / every OSD booted, so a single read races
-            # (num_mons came back 2-of-3 in the wild)
-            deadline = time.monotonic() + 30
+            # (num_mons came back 2-of-3 in the wild; 90 s: elections
+            # among freshly spawned mon processes stall behind suite-
+            # load compiles)
+            deadline = time.monotonic() + 90
             while True:
                 rc, outs, outb = await c.client.mon_command(["status"])
                 assert rc == 0
@@ -419,7 +421,7 @@ def test_multiprocess_mon_command(tmp_path):
             assert c.client.osdmap.osds[2].weight == 0x8000
             # quorum_status names a leader all ranks agree on (same
             # deadline poll: membership may still be converging)
-            deadline = time.monotonic() + 30
+            deadline = time.monotonic() + 90
             while True:
                 rc, _, outb = await c.client.mon_command(["quorum_status"])
                 q = json.loads(outb)

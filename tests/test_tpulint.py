@@ -446,6 +446,49 @@ def test_lock_mu_hint_is_suffix_only():
     assert len(out) == 1 and out[0].symbol == "PG.sneaky"
 
 
+def test_lock_fault_hook_awaited_under_lock_fires():
+    """The fault-plane extension: an AWAITED fault hook while holding
+    a PG lock turns an injected one-op pause into a whole-PG stall
+    with the lock pinned — must fire."""
+    out = lint(
+        """
+        import asyncio
+
+        class PG:
+            def __init__(self):
+                self.lock = asyncio.Lock()
+
+            async def do_op(self, osd):
+                async with self.lock:
+                    await osd.fault.pause("op_delay")
+        """,
+        "ceph_tpu/cluster/fixture.py", only=["lock-discipline"])
+    assert len(out) == 1
+    assert "fault-injection hook" in out[0].message
+    assert out[0].symbol == "PG.do_op"
+
+
+def test_lock_fault_hook_sync_or_outside_lock_is_clean():
+    # sync hit() under a lock is one dict lookup (fine); awaiting the
+    # hook OUTSIDE the lock is the idiomatic placement (osd._client_op)
+    out = lint(
+        """
+        import asyncio
+
+        class PG:
+            def __init__(self):
+                self.lock = asyncio.Lock()
+
+            async def do_op(self, osd):
+                await osd.fault.pause("op_delay")
+                async with self.lock:
+                    if osd.fault.hit("eio"):
+                        raise IOError("injected")
+        """,
+        "ceph_tpu/cluster/fixture.py", only=["lock-discipline"])
+    assert out == []
+
+
 def test_lock_out_of_scope_dir_is_ignored():
     out = lint(
         """

@@ -1,0 +1,118 @@
+#!/usr/bin/env python
+"""thrash: seeded EC thrash runner with a JSON verdict.
+
+The teuthology thrasher verb for this repo (qa/tasks/thrashosds role):
+assemble an in-process TestCluster, create a k/m EC pool, run a
+deterministic fault schedule (OSD kill/revive/flap, one rolling
+partition, bitrot on a fraction of reads, optional mon failover when
+--mons > 1) under concurrent oracle-checked writers, then demand
+convergence — active+clean, a deep-scrub round finding nothing after
+one repair pass, and byte-exact oracle reads.
+
+Usage:
+    python tools/thrash.py --seed 7 --duration 20
+    python tools/thrash.py --seed 7 --osds 5 --k 3 --m 2 \
+        --bitrot 0.01 --max-unavail 2 --duration 60
+
+Exit codes: 0 the verdict passed, 1 it failed, 2 usage error.
+Same seed => same schedule => same verdict (the replayability
+contract the fault plane exists for).
+"""
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+from pathlib import Path
+
+_REPO = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(_REPO))
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="thrash", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--seed", type=int, default=0,
+                    help="fault-plane seed (default %(default)s)")
+    ap.add_argument("--duration", type=float, default=20.0,
+                    help="thrash phase seconds (default %(default)s)")
+    ap.add_argument("--osds", type=int, default=5)
+    ap.add_argument("--mons", type=int, default=1,
+                    help=">1 runs a Paxos quorum and enables mon "
+                         "failover events")
+    ap.add_argument("--k", type=int, default=3)
+    ap.add_argument("--m", type=int, default=2)
+    ap.add_argument("--pg-num", type=int, default=8)
+    ap.add_argument("--max-unavail", type=int, default=None,
+                    help="max simultaneously killed/partitioned OSDs "
+                         "(default: m)")
+    ap.add_argument("--bitrot", type=float, default=0.01,
+                    help="P(bit-flip) per shard read (default 1%%)")
+    ap.add_argument("--no-partitions", action="store_true")
+    ap.add_argument("--objects", type=int, default=8)
+    ap.add_argument("--obj-size", type=int, default=24 << 10)
+    ap.add_argument("--writers", type=int, default=4)
+    ap.add_argument("--settle", type=float, default=90.0,
+                    help="post-heal convergence deadline seconds")
+    ap.add_argument("--schedule-only", action="store_true",
+                    help="print the deterministic schedule and exit "
+                         "(no cluster)")
+    args = ap.parse_args(argv)
+    if args.k < 2 or args.m < 1 or args.osds < args.k + args.m:
+        ap.error("need osds >= k + m, k >= 2, m >= 1")
+    max_unavail = args.max_unavail if args.max_unavail is not None \
+        else args.m
+
+    from ceph_tpu.cluster.faults import build_schedule
+
+    if args.schedule_only:
+        sched = build_schedule(args.seed, args.duration, args.osds,
+                               max_unavail=max_unavail,
+                               partitions=not args.no_partitions,
+                               mon_flaps=args.mons > 1)
+        print(json.dumps({"seed": args.seed,
+                          "events": [[e.t, e.kind, e.target]
+                                     for e in sched]}, indent=1))
+        return 0
+
+    verdict = asyncio.run(_run(args, max_unavail))
+    print(json.dumps(verdict, indent=1, sort_keys=True))
+    return 0 if verdict["passed"] else 1
+
+
+async def _run(args, max_unavail: int) -> dict:
+    from ceph_tpu.cluster.faults import Thrasher
+    from ceph_tpu.cluster.vstart import TestCluster
+    from ceph_tpu.placement.osdmap import Pool
+
+    c = TestCluster(n_osds=args.osds, n_mons=args.mons,
+                    fault_seed=args.seed)
+    await c.start()
+    # the oracle's ordering contract: one tid per op for the whole
+    # thrash — the op must outlive any partition, so the deadline
+    # has to exceed the thrash+settle horizon
+    c.client.op_timeout = args.duration + args.settle + 60.0
+    pool_id = await c.client.create_pool(Pool(
+        id=2, name="thrash", size=args.k + args.m, min_size=args.k,
+        pg_num=args.pg_num, crush_rule=1, type="erasure",
+        ec_profile={"plugin": "rs_tpu", "k": str(args.k),
+                    "m": str(args.m), "backend": "auto"}))
+    await c.wait_active(30)
+    thrasher = Thrasher(
+        c, pool_id, seed=args.seed, duration=args.duration,
+        max_unavail=max_unavail, bitrot_p=args.bitrot,
+        partitions=not args.no_partitions, mon_flaps=args.mons > 1,
+        n_objects=args.objects, obj_size=args.obj_size,
+        writers=args.writers, settle_timeout=args.settle)
+    try:
+        verdict = await thrasher.run()
+        verdict["health"] = c.mon.health()
+    finally:
+        await c.stop()
+    return verdict
+
+
+if __name__ == "__main__":
+    sys.exit(main())
