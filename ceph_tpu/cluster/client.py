@@ -65,6 +65,30 @@ def absent_attr(e: BaseException) -> bool:
     return isinstance(e, RadosError) and e.code == RadosError.ENODATA
 
 
+class Completion:
+    """Handle of one aio op (librados AioCompletion role): ``await
+    wait()`` for the reply — raising exactly what the synchronous call
+    would — or poll ``done()``. Completions of ops on the SAME object
+    resolve in submission order (the Objecter's per-object ordering
+    contract); ops on different objects complete independently."""
+
+    __slots__ = ("_fut",)
+
+    def __init__(self, fut: asyncio.Future):
+        self._fut = fut
+
+    def done(self) -> bool:
+        return self._fut.done()
+
+    async def wait(self):
+        """Block until the op completed; returns the MOSDOpReply (outs
+        carry per-op outputs) or raises the op's failure."""
+        return await asyncio.shield(self._fut)
+
+    def result(self):
+        return self._fut.result()
+
+
 @dataclass
 class _InFlight:
     msg: M.MOSDOp
@@ -105,6 +129,20 @@ class RadosClient:
         self._placement = PlacementMemo()
         self._next_cookie = 0
         self._tracer = trace.get_tracer(name)
+        # ---- aio op window (Objecter in-flight budget role): aio
+        # submissions block once client_max_inflight ops are in flight,
+        # which is what lets ONE task drive a deep pipeline with
+        # bounded memory instead of N tasks x blocking awaits
+        self._aio_inflight = 0
+        self._aio_waiters: list[asyncio.Future] = []
+        self._aio_idle: list[asyncio.Future] = []
+        self._aio_tasks: set[asyncio.Task] = set()
+        #: per-object completion chain: (pool, oid) -> the future of the
+        #: newest aio op on that object (next op executes after it)
+        self._obj_tail: dict[tuple[int, bytes], asyncio.Future] = {}
+        #: window occupancy at each aio submission (sum/count/max) —
+        #: the inflight_window_occupancy numbers bench config 6 reports
+        self.window_stats = {"sum": 0, "count": 0, "max": 0}
 
     # ---------------------------------------------------------- lifecycle
 
@@ -404,6 +442,146 @@ class RadosClient:
         (IoCtxImpl::operate role); returns each op's output bytes."""
         reply = await self._submit(pool_id, name, op.ops)
         return [d for _r, d in reply.outs]
+
+    # ------------------------------------------------------ aio window
+
+    def _window_budget(self) -> int:
+        return max(1, int(self.conf["client_max_inflight"]))
+
+    async def writes_begin(self) -> None:
+        """Claim one window slot, blocking while client_max_inflight
+        ops are already in flight (Objecter::_take_op_budget role).
+        The blocking IS the backpressure: a submitter pushing faster
+        than the cluster drains parks here, never grows unbounded."""
+        loop = asyncio.get_running_loop()
+        while self._aio_inflight >= self._window_budget():
+            fut = loop.create_future()
+            self._aio_waiters.append(fut)
+            try:
+                await fut
+            except asyncio.CancelledError:
+                if fut.done() and not fut.cancelled():
+                    # this waiter consumed a slot wakeup it will never
+                    # use: hand it to the next parked submitter or the
+                    # free slot is lost and the window wedges (the
+                    # asyncio.Semaphore lost-wakeup hazard)
+                    for w in self._aio_waiters:
+                        if w is not fut and not w.done():
+                            w.set_result(None)
+                            break
+                raise
+            finally:
+                if fut in self._aio_waiters:
+                    self._aio_waiters.remove(fut)
+        self._aio_inflight += 1
+        s = self.window_stats
+        s["sum"] += self._aio_inflight
+        s["count"] += 1
+        if self._aio_inflight > s["max"]:
+            s["max"] = self._aio_inflight
+
+    def _writes_end(self) -> None:
+        self._aio_inflight -= 1
+        for fut in self._aio_waiters:
+            if not fut.done():
+                fut.set_result(None)
+                break  # one freed slot wakes one submitter
+        if self._aio_inflight == 0:
+            for fut in self._aio_idle:
+                if not fut.done():
+                    fut.set_result(None)
+            self._aio_idle.clear()
+
+    async def writes_wait(self) -> None:
+        """Drain the window: return once every aio op submitted so far
+        has completed (librados aio_flush role). Individual failures
+        stay on their completions — a barrier must not eat them."""
+        if self._aio_inflight == 0:
+            return
+        fut = asyncio.get_running_loop().create_future()
+        self._aio_idle.append(fut)
+        await fut
+
+    async def aio_submit(self, pool_id: int, name, ops: list[tuple],
+                         snapc=None, snapid=None) -> Completion:
+        """Submit one op vector into the in-flight window and return a
+        Completion instead of awaiting the reply. The full per-op
+        machinery — target calc, tick-resend, ESTALE/EAGAIN backoff —
+        runs unchanged inside the window (each op rides _submit); ops
+        on the same object are chained so they execute, and complete,
+        in submission order."""
+        await self.writes_begin()
+        oid = name.encode() if isinstance(name, str) else bytes(name)
+        key = (pool_id, oid)
+        prev = self._obj_tail.get(key)
+        loop = asyncio.get_running_loop()
+        fut = loop.create_future()
+        # completions dropped without a wait() must not spam the loop's
+        # "exception never retrieved" warning — the op's failure is
+        # still observable via wait()/result()
+        fut.add_done_callback(
+            lambda f: f.exception() if not f.cancelled() else None)
+        self._obj_tail[key] = fut
+        task = loop.create_task(
+            self._aio_drive(pool_id, name, ops, snapc, snapid, prev,
+                            fut, key))
+        self._aio_tasks.add(task)
+        task.add_done_callback(self._aio_tasks.discard)
+        return Completion(fut)
+
+    async def _aio_drive(self, pool_id: int, name, ops, snapc, snapid,
+                         prev: asyncio.Future | None,
+                         fut: asyncio.Future, key) -> None:
+        try:
+            if prev is not None and not prev.done():
+                # per-object order: the previous op on this object must
+                # finish (its failure is its own — this op still runs)
+                try:
+                    await asyncio.shield(prev)
+                except Exception:
+                    pass
+            reply = await self._submit(pool_id, name, ops, snapc=snapc,
+                                       snapid=snapid)
+        except asyncio.CancelledError:
+            if not fut.done():
+                fut.cancel()
+            raise
+        except BaseException as e:
+            if not fut.done():
+                fut.set_exception(e)
+        else:
+            if not fut.done():
+                fut.set_result(reply)
+        finally:
+            if self._obj_tail.get(key) is fut:
+                del self._obj_tail[key]
+            self._writes_end()
+
+    async def aio_write_full(self, pool_id: int, name, data: bytes,
+                             snapc=None) -> Completion:
+        return await self.aio_submit(
+            pool_id, name, [M.osd_op("writefull", data=bytes(data))],
+            snapc=snapc)
+
+    async def aio_write(self, pool_id: int, name, offset: int,
+                        data: bytes, snapc=None) -> Completion:
+        return await self.aio_submit(
+            pool_id, name,
+            [M.osd_op("write", offset=offset, data=bytes(data))],
+            snapc=snapc)
+
+    async def aio_append(self, pool_id: int, name, data: bytes,
+                         snapc=None) -> Completion:
+        return await self.aio_submit(
+            pool_id, name, [M.osd_op("append", data=bytes(data))],
+            snapc=snapc)
+
+    async def aio_operate(self, pool_id: int, name,
+                          op: "ObjectOperation") -> Completion:
+        """Compound ObjectOperation through the window (the
+        aio_operate role); wait() returns the reply whose outs carry
+        each op's output bytes."""
+        return await self.aio_submit(pool_id, name, op.ops)
 
     async def list_objects(self, pool_id: int) -> list[bytes]:
         """All object names in the pool via a concurrent PGLS sweep of
@@ -840,6 +1018,8 @@ _NAME_METHODS = frozenset((
     "stat", "delete", "operate", "getxattr", "setxattr", "rmxattr",
     "getxattrs", "omap_set", "omap_get", "omap_rm", "watch",
     "unwatch", "notify", "execute",
+    "aio_submit", "aio_write_full", "aio_write", "aio_append",
+    "aio_operate",
 ))
 
 

@@ -106,13 +106,22 @@ async def _amain(args) -> None:
                              hb_grace=args.hb_grace,
                              out_interval=args.out_interval)
     elif args.role == "osd":
+        from ..utils import config as cfg
         from .osd import OSDLite
 
+        conf = cfg.proxy()
+        store_kw = {}
+        if args.objectstore != "memstore":
+            # store-side group commit rides the daemon config (the
+            # store_commit_window_ms/store_commit_max_txns knob pair)
+            store_kw = dict(
+                commit_window_ms=float(conf["store_commit_window_ms"]),
+                commit_max_txns=int(conf["store_commit_max_txns"]))
         store = store_mod.create(
             args.objectstore,
-            os.path.join(args.store_dir, f"osd.{args.id}"))
+            os.path.join(args.store_dir, f"osd.{args.id}"), **store_kw)
         daemon = OSDLite(bus, args.id, store=store,
-                         hb_interval=args.hb_interval)
+                         hb_interval=args.hb_interval, conf=conf)
     elif args.role == "mds":
         # metadata daemon (src/ceph_mds.cc main role): its own RADOS
         # client on the bus; metadata pool via --pool. Spawned AFTER

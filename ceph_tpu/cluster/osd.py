@@ -222,6 +222,32 @@ class OSDLite:
         self._subtid += 1
         return self._subtid
 
+    def queue_txn(self, t) -> "asyncio.Future | None":
+        """queue_transaction with an awaitable durability barrier:
+        returns None when the store flushes inline (legacy shape —
+        the call's return IS the barrier), else a future resolving
+        when the transaction's commit group flushed. Any ack that
+        implies durability to a peer or client (sub-write replies,
+        the primary's own fan-out apply) MUST await it — replying out
+        of the group-commit window would ack writes a crash can still
+        lose."""
+        if not self.store.commits_deferred():
+            self.store.queue_transaction(t)
+            return None
+        loop = asyncio.get_running_loop()
+        fut = loop.create_future()
+        # on_commit fires on the committer's flusher thread
+        self.store.queue_transaction(
+            t, lambda: loop.call_soon_threadsafe(
+                lambda: fut.done() or fut.set_result(None)))
+        return fut
+
+    async def txn_durable(self, fut: "asyncio.Future | None") -> None:
+        """Await a queue_txn barrier (bounded like any sub-op wait: a
+        store whose flush is wedged must fail the op, not hang it)."""
+        if fut is not None:
+            await asyncio.wait_for(fut, self.subop_timeout)
+
     def expect_reply(self, key) -> asyncio.Future:
         fut = asyncio.get_running_loop().create_future()
         self.pending[key] = fut

@@ -1212,28 +1212,59 @@ class PG:
         self._ensure_coll(local)
         local.ops.extend(self._filter_remote_ops(mut))
         self._append_and_persist(entries, local)
-        self.osd.store.queue_transaction(local)
+        local_barrier = self.osd.queue_txn(local)
         # live objects: LocalBus delivers by reference; wire
         # messengers marshal via the LAZY_TXN/LAZY_ENTRIES codecs
 
         async def _ship(o: int):
             subtid = self.osd.new_subtid()
             fut = self.osd.expect_reply(subtid)
-            await self.osd.send(
-                f"osd.{o}",
-                M.MOSDRepOp(tid=subtid, pgid=self.pgid, txn=mut,
-                            entry=entries,
-                            epoch=self.osd.osdmap.epoch,
-                            prev_head=self.acked_head,
-                            trace=_trace_ctx()),
-            )
+            try:
+                await self.osd.send(
+                    f"osd.{o}",
+                    M.MOSDRepOp(tid=subtid, pgid=self.pgid, txn=mut,
+                                entry=entries,
+                                epoch=self.osd.osdmap.epoch,
+                                prev_head=self.acked_head,
+                                trace=_trace_ctx()),
+                )
+            except BaseException:
+                self.osd.drop_reply(subtid)
+                raise
             return (o, subtid, fut)
 
-        waits = [await _ship(o) for o, _s in peers]
-        extra_waits = [await _ship(o) for o, _s in extra_peers]
+        # ship concurrently: the corked messenger coalesces the whole
+        # fan-out into one burst per peer connection. Send failures
+        # are classified per target: an acting send failure fails the
+        # op through the SAME cleanup path as a failed ack (demote +
+        # re-peer, pending futures dropped); extras stay best-effort.
+        n_act = len(peers)
+        shipped = await asyncio.gather(
+            *(_ship(o) for o, _s in peers),
+            *(_ship(o) for o, _s in extra_peers),
+            return_exceptions=True)
+        waits, extra_waits = [], []
+        extras_ok, acting_exc = True, None
+        for i, res in enumerate(shipped):
+            if isinstance(res, BaseException):
+                if i < n_act:
+                    acting_exc = acting_exc or res
+                else:
+                    extras_ok = False
+            elif i < n_act:
+                waits.append(res)
+            else:
+                extra_waits.append(res)
         try:
+            if acting_exc is not None:
+                raise acting_exc
             await self.osd.gather(waits)
+            # primary's own apply joins the all-acked barrier (group-
+            # commit stores defer the flush past queue_transaction)
+            await self.osd.txn_durable(local_barrier)
         except BaseException:
+            for _o, subtid, _f in waits + extra_waits:
+                self.osd.drop_reply(subtid)
             self._mig_fanout_done(entries[-1].oid, ok=False)
             self._repeer_on_subop_failure()
             raise
@@ -1243,10 +1274,11 @@ class PG:
         # bounced/lost extra delta just demotes the oid for re-push
         if entries[-1].version > self.acked_head:
             self.acked_head = entries[-1].version
-        await self._gather_extras(entries[-1].oid, extra_waits)
+        await self._gather_extras(entries[-1].oid, extra_waits,
+                                  ok=extras_ok)
 
-    async def _gather_extras(self, oid: bytes, extra_waits) -> None:
-        ok = True
+    async def _gather_extras(self, oid: bytes, extra_waits,
+                             ok: bool = True) -> None:
         for o, subtid, fut in extra_waits:
             try:
                 reply = await asyncio.wait_for(fut,
@@ -1504,6 +1536,8 @@ class PG:
                         "delta write bounced pending recovery")
         waits = []
         extra_waits = []
+        sends = []
+        local_barriers = []
         for pos, t in shard_txns.items():
             targets = []
             if live.get(pos) is not None:
@@ -1514,15 +1548,15 @@ class PG:
             hp = hpatch[pos] if isinstance(hpatch, dict) else hpatch
             for target, is_extra in targets:
                 if target == osd.id:
-                    self._apply_shard_write(self._shard_cid(pos), t,
-                                            entries, hp, ncells, size,
-                                            version)
+                    local_barriers.append(self._apply_shard_write(
+                        self._shard_cid(pos), t, entries, hp, ncells,
+                        size, version))
                     continue
                 subtid = osd.new_subtid()
                 fut = osd.expect_reply(subtid)
-                (extra_waits if is_extra else waits).append(
-                    (target, subtid, fut))
-                await osd.send(
+                wait = (target, subtid, fut)
+                (extra_waits if is_extra else waits).append(wait)
+                sends.append((is_extra, wait, osd.send(
                     f"osd.{target}",
                     M.MECSubWrite(tid=subtid, pgid=self.pgid, shard=pos,
                                   txn=t,
@@ -1531,17 +1565,45 @@ class PG:
                                   ncells=ncells, size=size,
                                   prev_head=self.acked_head,
                                   trace=_trace_ctx()),
-                )
+                )))
+        extras_ok, acting_exc = True, None
+        if sends:
+            # one concurrent burst, not k+m serialized awaits: a corked
+            # wire messenger turns the whole fan-out into one write +
+            # one drain per peer connection. Failures classify per
+            # target: acting sends fail the op via the cleanup path
+            # below; extra (migration) sends stay best-effort — but a
+            # failed extra's wait is dropped NOW, or _gather_extras
+            # would stall a whole subop_timeout on a reply that can
+            # never come
+            results = await asyncio.gather(*(s for *_x, s in sends),
+                                           return_exceptions=True)
+            for (is_extra, wait, _s), res in zip(sends, results):
+                if isinstance(res, BaseException):
+                    if is_extra:
+                        extras_ok = False
+                        extra_waits.remove(wait)
+                        osd.drop_reply(wait[1])
+                    elif acting_exc is None:
+                        acting_exc = res
         try:
+            if acting_exc is not None:
+                raise acting_exc
             await osd.gather(waits)
+            # the primary's OWN shard must be as durable as the acks it
+            # just gathered before the client sees success
+            for barrier in local_barriers:
+                await osd.txn_durable(barrier)
         except BaseException:
+            for _t, subtid, _f in waits + extra_waits:
+                osd.drop_reply(subtid)
             self._mig_fanout_done(oid, ok=False)
             self._repeer_on_subop_failure()
             raise
         # see _rep_fanout: acting all-acked; extras are best-effort
         if version > self.acked_head:
             self.acked_head = version
-        await self._gather_extras(oid, extra_waits)
+        await self._gather_extras(oid, extra_waits, ok=extras_ok)
 
     def _repeer_on_subop_failure(self) -> None:
         """An acting member failed/bounced a sub-write: something is
@@ -1624,7 +1686,9 @@ class PG:
             # its CRC/size/version attrs or log suffix, and scrub /
             # peering must detect and repair the divergence
             full.ops = full.ops[: max(1, len(full.ops) // 2)]
-        osd.store.queue_transaction(full)
+        # the returned barrier (group-commit stores only) must be
+        # awaited before ANY ack built on this write leaves the daemon
+        return osd.queue_txn(full)
 
     async def _ec_remote_meta(self, oid: bytes):
         """(size, user-attrs) of an EC object from any peer shard, or
@@ -1632,18 +1696,26 @@ class PG:
         issued concurrently). Used when the primary's own shard lacks
         the object (hole being backfilled)."""
         waits = []
+        sends = []
         for pos, target in sorted(
             (s, o) for o, s in self.live_members() if o != self.osd.id
         ):
             subtid = self.osd.new_subtid()
             fut = self.osd.expect_reply(subtid)
             waits.append((target, subtid, fut))
-            await self.osd.send(
+            sends.append(self.osd.send(
                 f"osd.{target}",
                 M.MECSubRead(tid=subtid, pgid=self.pgid, shard=pos,
                              oid=oid, offset=0, length=0,
                              trace=_trace_ctx()),
-            )
+            ))
+        if sends:
+            try:
+                await asyncio.gather(*sends)
+            except BaseException:
+                for _t, subtid, _f in waits:
+                    self.osd.drop_reply(subtid)
+                raise
         found = None
         for target, subtid, fut in waits:
             reply = await self.osd.await_reply(subtid, fut, target)
@@ -1741,6 +1813,7 @@ class PG:
                         f"{sorted(failed)} unreadable"
                     )
                 waits = []
+                sends = []
                 for j in sorted(need):
                     if j in chunks:
                         continue
@@ -1754,7 +1827,11 @@ class PG:
                             chunk = bytes(osd.store.read(cid, oid, coff,
                                                          clen))
                             chunk = self._maybe_bitflip(chunk, oid, j)
-                            if verify:
+                            # whole-shard reads always verify, knob or
+                            # not — symmetric with handle_ec_read's
+                            # remote length==-1 stance (rotted cells
+                            # must never feed a rebuild)
+                            if verify or clen == -1:
                                 self._verify_hinfo(cid, oid, chunk,
                                                    first_cell=s0)
                             chunks[j] = chunk
@@ -1782,12 +1859,19 @@ class PG:
                     subtid = osd.new_subtid()
                     fut = osd.expect_reply(subtid)
                     waits.append((j, target, subtid, fut))
-                    await osd.send(
+                    sends.append(osd.send(
                         f"osd.{target}",
                         M.MECSubRead(tid=subtid, pgid=self.pgid, shard=j,
                                      oid=oid, offset=coff, length=clen,
                                      trace=_trace_ctx()),
-                    )
+                    ))
+                if sends:
+                    try:
+                        await asyncio.gather(*sends)
+                    except BaseException:
+                        for _j, _t, subtid, _f in waits:
+                            osd.drop_reply(subtid)
+                        raise
                 for j, target, subtid, fut in waits:
                     reply = await osd.await_reply(subtid, fut, target)
                     if reply.result == M.OK:
@@ -1805,30 +1889,12 @@ class PG:
                         failed.add(j)
                 if not all(j in chunks for j in need):
                     continue
-                if self._ec_version_check and vers:
-                    vmax = max(vers.get(j, ZERO) for j in chunks)
-                    stale = [j for j in chunks
-                             if vers.get(j, ZERO) < vmax]
-                    if stale:
-                        # version-lagging shards are demoted exactly
-                        # like hinfo-CRC failures and the plan retried
-                        # from survivors; their data is kept in case
-                        # the newest generation can't reach k and the
-                        # group fallback has to serve the older one
-                        for j in stale:
-                            demoted[j] = chunks.pop(j)
-                            failed.add(j)
-                        continue
+                if self._demote_version_laggards(chunks, vers, demoted,
+                                                 failed):
+                    continue  # re-plan from the surviving quorum
                 break
-            # true laggards — behind the generation actually served —
-            # are counted and repaired; shards the fallback judged
-            # AHEAD of the served generation are not stale
-            sel_ver = max((vers.get(j, ZERO) for j in chunks),
-                          default=ZERO)
-            for j in demoted:
-                if j not in chunks and vers.get(j, ZERO) < sel_ver:
-                    osd.perf.inc("ec_read_stale_shard")
-                    self._kick_read_repair(oid, j, live, vers.get(j))
+            self._count_stale_demotions(chunks, vers, demoted,
+                                        oid=oid, live=live)
             # authoritative size: the served generation's size attr
             # (the primary's own attr may be the stale one)
             if vers and chunks:
@@ -1949,6 +2015,43 @@ class PG:
             row[: decoded[p].size] = decoded[p]
             out[:, i, :] = row.reshape(ncells, si.su)
         return out
+
+    def _demote_version_laggards(self, chunks: dict, vers: dict,
+                                 demoted: dict,
+                                 failed: set) -> bool:
+        """ATTR_V cross-check shared by _read_ec and
+        _reconstruct_chunk (the stale-shard hardening of PR 3, deduped
+        per its review notes): every fetched shard lagging the max
+        fetched version is demoted exactly like a hinfo-CRC failure —
+        excluded from the plan, its data KEPT for the group fallback —
+        and the caller re-plans from survivors when this returns
+        True. A revived stale shard is self-consistent against its own
+        stale hinfo, so version lag is the ONLY signal that catches
+        it."""
+        if not (self._ec_version_check and vers and chunks):
+            return False
+        vmax = max(vers.get(j, ZERO) for j in chunks)
+        stale = [j for j in chunks if vers.get(j, ZERO) < vmax]
+        for j in stale:
+            demoted[j] = chunks.pop(j)
+            failed.add(j)
+        return bool(stale)
+
+    def _count_stale_demotions(self, chunks: dict, vers: dict,
+                               demoted: dict, oid: bytes | None = None,
+                               live: dict | None = None) -> None:
+        """True laggards — behind the generation actually SERVED — are
+        counted (ec_read_stale_shard); shards a group fallback judged
+        ahead of the served generation are not stale. With ``live``
+        set, each counted laggard also gets an async repair kicked
+        (the read path does; a reconstruct's caller reinstalls the
+        rebuilt shard itself)."""
+        sel_ver = max((vers.get(j, ZERO) for j in chunks), default=ZERO)
+        for j in demoted:
+            if j not in chunks and vers.get(j, ZERO) < sel_ver:
+                self.osd.perf.inc("ec_read_stale_shard")
+                if live is not None:
+                    self._kick_read_repair(oid, j, live, vers.get(j))
 
     def _maybe_bitflip(self, chunk: bytes, oid: bytes,
                        shard: int) -> bytes:
@@ -2113,7 +2216,7 @@ class PG:
                 self.log.append(entry)
         self.log.trim(self.osd.log_keep)
         self._persist_log(full)
-        self.osd.store.queue_transaction(full)
+        await self.osd.txn_durable(self.osd.queue_txn(full))
         self.osd.perf.inc("subop_w")
         await self.osd.send(
             src,
@@ -2149,8 +2252,12 @@ class PG:
                                    shard=m.shard, result=M.ESTALE),
             )
             return
-        self._apply_shard_write(self.cid, t, entries, m.hpatch, m.ncells,
-                                m.size, entries[-1].version)
+        barrier = self._apply_shard_write(self.cid, t, entries, m.hpatch,
+                                          m.ncells, m.size,
+                                          entries[-1].version)
+        # group-commit store: the OK below feeds the primary's all-ack
+        # and ultimately the client's — it must not outrun the flush
+        await self.osd.txn_durable(barrier)
         self.osd.perf.inc("subop_w")
         await self.osd.send(
             src,
@@ -3126,19 +3233,11 @@ class PG:
                     chunks[j] = got
             if not all(j in chunks for j in need):
                 continue  # re-plan with the enlarged failed set
-            if self._ec_version_check and vers:
-                vmax = max(vers.get(j, ZERO) for j in chunks)
-                stale = [j for j in chunks if vers.get(j, ZERO) < vmax]
-                if stale:
-                    for j in stale:
-                        demoted[j] = chunks.pop(j)
-                        failed.add(j)
-                    continue
+            if self._demote_version_laggards(chunks, vers, demoted,
+                                             failed):
+                continue
             break
-        sel_ver = max((vers.get(j, ZERO) for j in chunks), default=ZERO)
-        for j in demoted:
-            if j not in chunks and vers.get(j, ZERO) < sel_ver:
-                self.osd.perf.inc("ec_read_stale_shard")
+        self._count_stale_demotions(chunks, vers, demoted)
         # size/attrs must come from the generation being rebuilt: the
         # max-version contributor (union keeps shard-invariant extras,
         # the best shard's values win conflicts)
@@ -3491,7 +3590,7 @@ class PG:
                 self.missing.pop(m.oid)
                 t0 = tx.Transaction()
                 self._persist_missing(t0)
-                self.osd.store.queue_transaction(t0)
+                await self.osd.txn_durable(self.osd.queue_txn(t0))
             await self.osd.send(
                 src,
                 M.MPushReply(pgid=self.pgid, shard=m.shard, oid=m.oid,
@@ -3571,7 +3670,10 @@ class PG:
         self._persist_log(t)
         if miss_dirty:
             self._persist_missing(t)
-        self.osd.store.queue_transaction(t)
+        # the ack tells the pusher recovery of this object is DONE
+        # (peer_missing pops on it): under a group-commit store it
+        # must not outrun the flush that makes the install durable
+        await self.osd.txn_durable(self.osd.queue_txn(t))
         await self.osd.send(
             src,
             M.MPushReply(pgid=self.pgid, shard=m.shard, oid=m.oid,

@@ -33,6 +33,11 @@ from .. import native
 _PROBE_B, _PROBE_K, _PROBE_WORDS = 64, 8, 2048
 
 _cached: str | None = None
+#: measured probe economics of the last _probe() run: both engines'
+#: per-batch seconds, so the bench can RECORD the device-engine number
+#: next to whichever engine the data path picked (empty when the
+#: engine was forced via CEPH_TPU_EC_ENGINE and no probe ran)
+last_probe: dict = {}
 #: the probe runs once per process — it is reached from ECBatcher
 #: executor WORKER threads, and two first-tick buckets probing
 #: concurrently would contend and cache a skewed verdict
@@ -73,14 +78,25 @@ def _probe() -> str:
         native.crc32c_batch(cells, threads=os.cpu_count() or 1)
         return time.perf_counter() - t0
 
+    data_bytes = _PROBE_B * _PROBE_K * cell_bytes
     try:
         jax.devices()
         dev_once()  # warm: compile + first transfer
         dt_dev = min(dev_once() for _ in range(2))
     except Exception:
+        last_probe.update({"probe_data_bytes": data_bytes,
+                           "device_s": None, "host_s": None,
+                           "device_unavailable": True})
         return "host"
     host_once()
     dt_host = min(host_once() for _ in range(2))
+    last_probe.update({
+        "probe_data_bytes": data_bytes,
+        "device_s": round(dt_dev, 6),
+        "host_s": round(dt_host, 6),
+        "device_mib_s": round(data_bytes / dt_dev / 2**20, 1),
+        "host_mib_s": round(data_bytes / dt_host / 2**20, 1),
+    })
     return "device" if dt_dev < dt_host else "host"
 
 

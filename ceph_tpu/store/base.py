@@ -9,7 +9,10 @@ and inherit the op interpreter, the way MemStore does in the reference
 """
 from __future__ import annotations
 
+import sys
 import threading
+import time
+import traceback
 from typing import Callable, Iterable
 
 from . import transaction as tx
@@ -36,6 +39,182 @@ def split_hash_oid(oid: bytes) -> bytes | None:
     if oid.startswith(CLONE_PREFIX):
         return oid[11:]
     return oid
+
+
+class CommitStats:
+    """Per-store group-commit accounting: every durable store bumps
+    these at each commit boundary so the bench can report how well
+    transactions amortize the flush (commits_grouped / txns_per_commit
+    / commit_flush_us — the store-side occupancy counters next to the
+    EC batcher's stripes_per_batch)."""
+
+    __slots__ = ("commits", "commits_grouped", "txns", "flush_us_sum")
+
+    def __init__(self) -> None:
+        self.commits = 0          # flush boundaries paid
+        self.commits_grouped = 0  # boundaries that covered > 1 txn
+        self.txns = 0             # transactions committed
+        self.flush_us_sum = 0.0   # total time inside the flush fn
+
+    def observe(self, ntxns: int, flush_s: float) -> None:
+        self.commits += 1
+        if ntxns > 1:
+            self.commits_grouped += 1
+        self.txns += ntxns
+        self.flush_us_sum += flush_s * 1e6
+
+    def dump(self) -> dict:
+        return {
+            "commits": self.commits,
+            "commits_grouped": self.commits_grouped,
+            "txns": self.txns,
+            "txns_per_commit": (self.txns / self.commits
+                                if self.commits else 0.0),
+            "commit_flush_us": (self.flush_us_sum / self.commits
+                                if self.commits else 0.0),
+        }
+
+
+class GroupCommitter:
+    """Window/size-bounded commit grouping (the BlueStore kv-sync
+    thread role): transactions arriving within ``window_s`` share ONE
+    durability flush (``flush_fn``), then their ``on_commit`` callbacks
+    fire together; a group reaching ``max_txns`` flushes ahead of the
+    deadline. ``window_s <= 0`` disables grouping — ``add`` flushes
+    inline, reproducing per-transaction durability exactly.
+
+    Locking contract: ``add``/``flush_now`` are called WITHOUT the
+    store lock held for the flush part; ``flush_fn`` takes the store
+    lock itself. The flusher thread never holds the group condition
+    while flushing, so store-lock holders can always enqueue."""
+
+    def __init__(self, flush_fn: Callable[[], None],
+                 stats: CommitStats | None = None,
+                 window_s: float = 0.0, max_txns: int = 64):
+        self.flush_fn = flush_fn
+        self.stats = stats
+        self.window_s = float(window_s)
+        self.max_txns = max(1, int(max_txns))
+        self._cond = threading.Condition()
+        self._cbs: list[Callable[[], None]] = []
+        self._ntxns = 0
+        self._deadline: float | None = None
+        self._thread: threading.Thread | None = None
+        self._stop = False
+
+    # ------------------------------------------------------------- entry
+
+    def add(self, on_commit: Callable[[], None] | None) -> None:
+        """One committed-to-memory transaction wants durability. In
+        grouped mode its flush (and callback) ride the group; inline
+        mode flushes now — on_commit exceptions then propagate to the
+        caller like the pre-group-commit path did."""
+        if self.window_s <= 0:
+            t0 = time.perf_counter()
+            self.flush_fn()
+            if self.stats is not None:
+                self.stats.observe(1, time.perf_counter() - t0)
+            if on_commit:
+                on_commit()
+            return
+        with self._cond:
+            self._ntxns += 1
+            if on_commit:
+                self._cbs.append(on_commit)
+            now = time.monotonic()
+            if self._deadline is None:
+                self._deadline = now + self.window_s
+            if self._ntxns >= self.max_txns:
+                self._deadline = now  # size trigger: flush ahead of it
+            self._ensure_thread()
+            self._cond.notify()
+
+    def _ensure_thread(self) -> None:  # _cond held
+        if self._thread is None or not self._thread.is_alive():
+            self._stop = False  # a closed committer revives on re-use
+            self._thread = threading.Thread(target=self._loop,
+                                            daemon=True)
+            self._thread.start()
+
+    # ------------------------------------------------------------- flush
+
+    def _steal(self) -> tuple[int, list]:  # _cond held
+        cbs, self._cbs = self._cbs, []
+        n, self._ntxns = self._ntxns, 0
+        self._deadline = None
+        return n, cbs
+
+    def _do_flush(self, n: int, cbs: list) -> None:
+        t0 = time.perf_counter()
+        try:
+            self.flush_fn()
+        except Exception:
+            # a failed flush must neither fire the callbacks (they
+            # mean DURABLE) nor drop them (their waiters would hang
+            # forever) nor kill the flusher: re-queue the group at the
+            # front, re-arm a retry deadline, and report. A transient
+            # error (EINTR, pressure) clears on the retry; a dead disk
+            # keeps the callbacks honestly un-fired.
+            print("group-commit flush failed (group re-queued):",
+                  file=sys.stderr)
+            traceback.print_exc()
+            with self._cond:
+                if self._stop:
+                    return  # closing: nothing will retry — drop, the
+                    #         callbacks were never durability-promised
+                self._cbs[:0] = cbs
+                self._ntxns += n
+                if self._deadline is None:
+                    self._deadline = (time.monotonic()
+                                      + max(self.window_s, 0.05))
+                self._ensure_thread()
+                self._cond.notify()
+            return
+        if self.stats is not None:
+            self.stats.observe(n, time.perf_counter() - t0)
+        for cb in cbs:
+            try:
+                cb()
+            except Exception:
+                # a grouped callback has no caller stack to fail into;
+                # its batch-mates' callbacks must still fire
+                print("group-commit on_commit callback failed:",
+                      file=sys.stderr)
+                traceback.print_exc()
+
+    def flush_now(self) -> None:
+        """Explicit barrier (umount, checkpoint, tests): flush whatever
+        is pending and fire its callbacks before returning."""
+        with self._cond:
+            n, cbs = self._steal()
+        if n:
+            self._do_flush(n, cbs)
+
+    def close(self) -> None:
+        self.flush_now()
+        with self._cond:
+            self._stop = True
+            self._cond.notify_all()
+        t = self._thread
+        if t is not None and t.is_alive():
+            t.join(timeout=5.0)
+
+    def _loop(self) -> None:
+        while True:
+            with self._cond:
+                while not self._stop and self._ntxns == 0:
+                    self._cond.wait()
+                if self._stop and self._ntxns == 0:
+                    return
+                now = time.monotonic()
+                while (not self._stop and self._deadline is not None
+                       and now < self._deadline
+                       and self._ntxns < self.max_txns):
+                    self._cond.wait(self._deadline - now)
+                    now = time.monotonic()
+                n, cbs = self._steal()
+            if n:
+                self._do_flush(n, cbs)
 
 
 class StoreError(Exception):
@@ -75,6 +254,12 @@ class Obj:
 class ObjectStore:
     """Abstract store; subclasses provide durability."""
 
+    def __init__(self) -> None:
+        #: group-commit occupancy counters (CommitStats): every store
+        #: kind reports the same shape, so `txns_per_commit` means the
+        #: same thing whether the flush is a WAL fsync or a kv batch
+        self.commit_stats = CommitStats()
+
     def mount(self) -> None: ...
 
     def umount(self) -> None: ...
@@ -85,6 +270,13 @@ class ObjectStore:
         self, t: tx.Transaction, on_commit: Callable[[], None] | None = None
     ) -> None:
         raise NotImplementedError
+
+    def commits_deferred(self) -> bool:
+        """True when queue_transaction may RETURN before the
+        transaction is durable (a group-commit window is armed): an
+        ack that implies durability must then ride on_commit instead
+        of the call's return (cluster/osd.py queue_txn)."""
+        return False
 
     def apply_transaction(self, t: tx.Transaction) -> None:
         """Synchronous convenience: queue + wait."""
@@ -183,6 +375,21 @@ class ObjectStore:
         # read-only lookups: peek avoids dragging untouched objects
         # through a staged overlay's copy-on-touch (plain dicts: get)
         peek = getattr(c.objects, "peek", c.objects.get)
+        if op.code == tx.OP_WRITE and a["offset"] == 0:
+            old = peek(op.oid)
+            if old is not None and len(a["data"]) >= len(old.data):
+                # full overwrite: build the replacement object from the
+                # new bytes directly instead of copy-on-touch cloning
+                # (and then fully overwriting) the old data — the EC
+                # shard-rewrite shape pays this per sub-op, and the
+                # clone was the write path's dominant memcpy
+                o = Obj()
+                o.data = bytearray(a["data"])
+                o.xattrs = dict(old.xattrs)
+                o.omap = dict(old.omap)
+                o.omap_header = old.omap_header
+                c.objects[op.oid] = o
+                return
         if op.code == tx.OP_TOUCH:
             if peek(op.oid) is None:
                 c.objects[op.oid] = Obj()
@@ -221,10 +428,18 @@ class ObjectStore:
             else:
                 raise NotFound(repr(op.oid))
         if op.code == tx.OP_WRITE:
-            end = a["offset"] + len(a["data"])
-            if len(o.data) < end:
-                o.data.extend(b"\0" * (end - len(o.data)))
-            o.data[a["offset"] : end] = a["data"]
+            off = a["offset"]
+            end = off + len(a["data"])
+            if off >= len(o.data):
+                # append shape (incl. a fresh object's first write):
+                # no zero-fill of bytes the data is about to cover
+                if off > len(o.data):
+                    o.data.extend(b"\0" * (off - len(o.data)))
+                o.data += a["data"]
+            else:
+                if len(o.data) < end:
+                    o.data.extend(b"\0" * (end - len(o.data)))
+                o.data[off:end] = a["data"]
         elif op.code == tx.OP_ZERO:
             end = a["offset"] + a["length"]
             if len(o.data) < end:

@@ -31,6 +31,7 @@ batched call (_verify_csum role, BlueStore.cc:11277).
 from __future__ import annotations
 
 import threading
+import time
 from typing import Callable
 
 import numpy as np
@@ -40,7 +41,7 @@ from ..native import rt
 from ..utils import compress as comp_mod
 from ..utils import denc
 from . import transaction as tx
-from .base import NotFound, ObjectStore, StoreError
+from .base import GroupCommitter, NotFound, ObjectStore, StoreError
 
 BLOCK = 4096
 HOLE = 0xFFFFFFFF  # block-map entry for an unallocated (all-zero) block
@@ -292,7 +293,7 @@ class _Txc:
             return self.staged[phys]
         if phys in self.deferred:
             return self.deferred[phys]
-        return self.store.dev.pread(phys * BLOCK, BLOCK)
+        return self.store._pread_block(phys)
 
     def _free_phys(self, p: int) -> None:
         """Free one physical block: staged-by-this-txc blocks roll back
@@ -321,7 +322,7 @@ class _Txc:
         raw = self._blob_raw_cache.get(key)
         if raw is None:
             comp = b"".join(
-                self.staged.get(p) or self.store.dev.pread(p * BLOCK, BLOCK)
+                self.staged.get(p) or self.store._pread_block(p)
                 for p in cb.phys)
             raw = self.store.compressor(cb.alg).decompress(comp[:cb.clen])
             self._blob_raw_cache[key] = raw
@@ -625,8 +626,7 @@ class _Txc:
                 # copy the COMPRESSED bytes verbatim — no decompression
                 new_phys = [
                     self.alloc_block(
-                        self.staged.get(p)
-                        or self.store.dev.pread(p * BLOCK, BLOCK))
+                        self.staged.get(p) or self.store._pread_block(p))
                     for p in cb.phys]
                 dst.cblobs[start] = CBlob(cb.nblocks, new_phys, cb.clen,
                                           cb.alg, list(cb.csums))
@@ -689,7 +689,10 @@ class BlueStoreLite(ObjectStore):
                  kv_compact_bytes: int = 64 << 20,
                  compression: str | None = None,
                  compression_mode: str = "aggressive",
-                 compression_required_ratio: float = 0.875):
+                 compression_required_ratio: float = 0.875,
+                 commit_window_ms: float = 0.0,
+                 commit_max_txns: int = 64):
+        super().__init__()
         self.path = str(path)
         self.dev_size = size
         self.fsync = fsync
@@ -710,6 +713,19 @@ class BlueStoreLite(ObjectStore):
         self.lock = threading.RLock()
         self._csum = Checksummer(alg="crc32c", csum_block_size=BLOCK)
         self._mounted = False
+        # group commit: with a window, each txc still checksums and
+        # lands its COW data blocks (drained) itself, but the kv batch
+        # — the commit point — the deferred in-place patches and the
+        # freed-block release accumulate and are paid ONCE per group
+        # (_flush_group). Pending deferred patch bytes stay readable
+        # through the _pending_defer overlay until they hit the device.
+        self._grouped = commit_window_ms > 0
+        self._pending_kv: list[tuple] = []
+        self._pending_defer: dict[int, bytes] = {}
+        self._pending_freed: list[int] = []
+        self._committer = GroupCommitter(
+            self._flush_group, stats=self.commit_stats,
+            window_s=commit_window_ms / 1e3, max_txns=commit_max_txns)
 
     def compressor(self, alg: str) -> comp_mod.Compressor:
         """Decompressor lookup by the algorithm recorded in the blob —
@@ -780,6 +796,7 @@ class BlueStoreLite(ObjectStore):
     def umount(self) -> None:
         if not self._mounted:
             return
+        self._committer.close()
         self.kv.compact()
         self.kv.close()
         self.dev.close()
@@ -787,6 +804,19 @@ class BlueStoreLite(ObjectStore):
         self._mounted = False
 
     # ------------------------------------------------------------- writes
+
+    def commits_deferred(self) -> bool:
+        return self._committer.window_s > 0
+
+    def _pread_block(self, phys: int) -> bytes:
+        """One committed block's CURRENT bytes: a deferred in-place
+        patch still waiting for its group flush shadows the device
+        (readers must see the committed-to-memory state, not the block
+        the patch has yet to overwrite)."""
+        pend = self._pending_defer.get(phys)
+        if pend is not None:
+            return pend
+        return self.dev.pread(phys * BLOCK, BLOCK)
 
     def queue_transaction(
         self, t: tx.Transaction, on_commit: Callable[[], None] | None = None
@@ -803,10 +833,21 @@ class BlueStoreLite(ObjectStore):
                     self.alloc.release(phys, 1)
                 raise
             self._commit(txc)
-        if on_commit:
+            if not self._grouped:
+                # legacy per-txn shape: the kv commit point lands
+                # under the SAME lock hold that folded the overlay —
+                # no reader can serve state whose batch hasn't run
+                t0 = time.perf_counter()
+                self._flush_group()
+                self.commit_stats.observe(1, time.perf_counter() - t0)
+        if self._grouped:
+            # grouped: the committer pays the kv batch + deferred
+            # patches + freed-block release once per window, then
+            # fires on_commit; inside the window visibility precedes
+            # durability by design (acks ride osd.queue_txn barriers)
+            self._committer.add(on_commit)
+        elif on_commit:
             on_commit()
-        if self.kv.wal_size() >= self.kv_compact_bytes:
-            self.kv.compact()
 
     def _commit(self, txc: _Txc) -> None:
         # batched checksums of every staged + deferred block (calc_csum
@@ -881,22 +922,19 @@ class BlueStoreLite(ObjectStore):
         for p in defer_list:
             ops.append(("put", K_DEFER + denc.enc_u64(p),
                         txc.deferred[p]))
-        if ops or txc.dirty or txc.coll_added or txc.coll_removed:
-            self.kv.batch(ops or [("put", b"\x00noop", b"")])
-
-        # DEFERRED: patch committed blocks in place, then drop the
-        # records (deferred_cleanup role). A crash in between replays
-        # them from the kv at mount — the pwrite is idempotent.
-        if defer_list and not getattr(self, "_crash_before_deferred",
-                                      False):
-            for p in defer_list:
-                self.dev.submit_write(p * BLOCK, txc.deferred[p])
-            if self.fsync:
-                self.dev.flush()
-            else:
-                self.dev.drain()
-            self.kv.batch([("del", K_DEFER + denc.enc_u64(p), None)
-                           for p in defer_list])
+        if not ops and (txc.dirty or txc.coll_added or txc.coll_removed):
+            ops = [("put", b"\x00noop", b"")]
+        # KV_SUBMIT is the committer's job now: the ops accumulate and
+        # the whole group commits as ONE atomic kv batch (inline mode
+        # flushes right after this txc — same prefix durability, the
+        # flush amortized over however many txns share the window).
+        # Deferred in-place patches stay readable via _pending_defer
+        # until they land; freed blocks release only after the group's
+        # commit point (re-allocating one earlier would let a crash
+        # before the batch corrupt metadata that still references it).
+        self._pending_kv.extend(ops)
+        self._pending_defer.update(txc.deferred)
+        self._pending_freed.extend(txc.freed)
 
         # FINISH: fold the overlay into the live maps — O(ops), not
         # O(objects in the PG)
@@ -913,8 +951,40 @@ class BlueStoreLite(ObjectStore):
                     tgt.pop(oid, None)
                 else:
                     tgt[oid] = o
-        for phys in txc.freed:
-            self.alloc.release(phys, 1)
+
+    def _flush_group(self) -> None:
+        """The group's commit point (txc KV_SUBMIT + deferred_cleanup,
+        amortized): one atomic kv batch covers every pending txn, then
+        the deferred in-place patches hit the device and their records
+        drop, then superseded blocks release. Serialized against all
+        reads/writes by the store lock, so a reader can never observe
+        the instant a patch moves from the overlay to the device."""
+        with self.lock:
+            ops, self._pending_kv = self._pending_kv, []
+            defers, self._pending_defer = self._pending_defer, {}
+            freed, self._pending_freed = self._pending_freed, []
+            if not (ops or defers or freed):
+                return
+            if ops:
+                self.kv.batch(ops)
+            # DEFERRED: patch committed blocks in place, then drop the
+            # records (deferred_cleanup role). A crash in between
+            # replays them from the kv at mount — the pwrite is
+            # idempotent.
+            if defers and not getattr(self, "_crash_before_deferred",
+                                      False):
+                for p in sorted(defers):
+                    self.dev.submit_write(p * BLOCK, defers[p])
+                if self.fsync:
+                    self.dev.flush()
+                else:
+                    self.dev.drain()
+                self.kv.batch([("del", K_DEFER + denc.enc_u64(p), None)
+                               for p in sorted(defers)])
+            for phys in freed:
+                self.alloc.release(phys, 1)
+            if self.kv.wal_size() >= self.kv_compact_bytes:
+                self.kv.compact()
 
     # -------------------------------------------------------------- reads
 
@@ -938,15 +1008,14 @@ class BlueStoreLite(ObjectStore):
             idx = [bi for bi in range(lo_b, hi_b)
                    if bi < len(o.blocks)
                    and o.blocks[bi] not in (HOLE, CBLOB)]
-            datas = {bi: self.dev.pread(o.blocks[bi] * BLOCK, BLOCK)
+            datas = {bi: self._pread_block(o.blocks[bi])
                      for bi in idx}
             # compressed blobs touched by the range: read their
             # physical blocks; verification joins the one batched call
             blobs: dict[int, CBlob] = {
                 s: cb for s, cb in o.cblobs.items()
                 if s < hi_b and s + cb.nblocks > lo_b}
-            blob_comp = {s: [self.dev.pread(p * BLOCK, BLOCK)
-                             for p in cb.phys]
+            blob_comp = {s: [self._pread_block(p) for p in cb.phys]
                          for s, cb in blobs.items()}
             rows = [datas[bi] for bi in idx]
             want_l = [o.csums[bi] for bi in idx]

@@ -157,6 +157,7 @@ class _Staging(dict):
 
 class MemStore(ObjectStore):
     def __init__(self) -> None:
+        super().__init__()
         self.colls: dict[str, Collection] = {}
         self.lock = threading.RLock()
 

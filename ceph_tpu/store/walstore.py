@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 from typing import Callable
 
 import numpy as np
@@ -35,7 +36,8 @@ from .. import native
 from ..checksum import Checksummer
 from ..utils import denc
 from . import transaction as tx
-from .base import Collection, NotFound, Obj, ObjectStore, StoreError
+from .base import (Collection, GroupCommitter, NotFound, Obj, ObjectStore,
+                   StoreError)
 from .memstore import MemStore
 
 WAL_NAME = "wal.log"
@@ -52,12 +54,22 @@ class WalStore(MemStore):
     def __init__(self, path: str, fsync: bool = False,
                  device_csum: bool = False,
                  wal_compact_bytes: int = 64 << 20,
-                 compression: str | None = "zlib"):
+                 compression: str | None = "zlib",
+                 commit_window_ms: float = 0.0,
+                 commit_max_txns: int = 64):
         super().__init__()
         self.path = path
         self.fsync = fsync
         self.device_csum = device_csum
         self.wal_compact_bytes = wal_compact_bytes
+        # group commit (store_commit_window_ms/store_commit_max_txns
+        # role): transactions arriving within the window append to the
+        # WAL individually but pay ONE flush (+fsync) at the group
+        # boundary, when their on_commit callbacks fire. 0 = flush per
+        # transaction (the legacy durability shape).
+        self._committer = GroupCommitter(
+            self._flush_wal, stats=self.commit_stats,
+            window_s=commit_window_ms / 1e3, max_txns=commit_max_txns)
         # checkpoint blob compression (bluestore_compression_algorithm
         # role); checksums stay over the RAW bytes so rot is attributed
         # to data, not codec framing
@@ -100,6 +112,7 @@ class WalStore(MemStore):
     def umount(self) -> None:
         if not self._mounted:
             return
+        self._committer.close()
         if self._compactor is not None:
             self._compactor.join()
         self.compact()
@@ -108,6 +121,19 @@ class WalStore(MemStore):
         self._mounted = False
 
     # ------------------------------------------------------------- writes
+
+    def commits_deferred(self) -> bool:
+        return self._committer.window_s > 0
+
+    def _flush_wal(self) -> None:
+        """The group's ONE durability barrier: flush the buffered WAL
+        records of every transaction in the group, fsync once."""
+        with self.lock:
+            if self._wal is None:
+                return
+            self._wal.flush()
+            if self.fsync:
+                os.fsync(self._wal.fileno())
 
     def queue_transaction(
         self, t: tx.Transaction, on_commit: Callable[[], None] | None = None
@@ -127,15 +153,29 @@ class WalStore(MemStore):
             )
             # KV_SUBMITTED: the record hits the log BEFORE the visible
             # state flips, so a failed append (ENOSPC…) leaves memory and
-            # log consistent; durable once flushed, only then on_commit
+            # log consistent; durable once the group's flush ran, only
+            # then on_commit (a crash in between replays the flushed
+            # prefix and discards the torn tail — exactly the per-txn
+            # contract, amortized)
             self._wal.write(rec)
-            self._wal.flush()
-            if self.fsync:
-                os.fsync(self._wal.fileno())
+            grouped = self._committer.window_s > 0
+            if not grouped:
+                # legacy per-txn shape: the flush lands under the SAME
+                # lock hold that makes the state visible — no reader
+                # can ever serve bytes whose record is still buffered
+                t0 = time.perf_counter()
+                self._flush_wal()
+                self.commit_stats.observe(
+                    1, time.perf_counter() - t0)
             self._wal_size += len(rec)
             self._commit_stage(staging)
             self._seq = seq
-        if on_commit:
+        if grouped:
+            # grouped: visibility precedes durability inside the
+            # window by design — acks that promise durability ride the
+            # on_commit barrier (cluster/osd.py queue_txn)
+            self._committer.add(on_commit)
+        elif on_commit:
             on_commit()
         if (self._wal_size >= self.wal_compact_bytes
                 and (self._compactor is None
@@ -152,6 +192,11 @@ class WalStore(MemStore):
     def compact(self) -> None:
         """Write a full snapshot, then truncate the WAL (the kv-compaction
         role; atomic via write-to-temp + rename)."""
+        # settle the pending group first: its transactions are already
+        # in the in-memory state the snapshot captures, so the snapshot
+        # IS their durability — but their callbacks must fire before
+        # the records that carried them vanish
+        self._committer.flush_now()
         with self.lock:
             blob = self._encode_snapshot()
             snap = os.path.join(self.path, SNAP_NAME)

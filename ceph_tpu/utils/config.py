@@ -222,6 +222,20 @@ SCHEMA = Schema([
                 "jitter (bounded exponential backoff)"),
     Option("client_backoff_max", "secs", 2.0, min=0.01,
            desc="retry delay ceiling of the client resend loops"),
+    Option("client_max_inflight", "int", 64, min=1,
+           desc="aio op window: ops in flight per client before "
+                "aio submission blocks (objecter_inflight_ops role); "
+                "the budget the writes_begin/writes_wait pipeline "
+                "amortizes per-op costs across"),
+    Option("store_commit_window_ms", "float", 0.0, min=0.0,
+           desc="store group-commit window: transactions arriving "
+                "within this many ms share ONE WAL/kv flush (+fsync) "
+                "and their on_commit callbacks fire together "
+                "(0 = flush per transaction, the legacy path)"),
+    Option("store_commit_max_txns", "int", 64, min=1,
+           desc="store group-commit size cap: a group reaching this "
+                "many transactions flushes immediately, ahead of the "
+                "window deadline"),
     Option("store_kind", "str", "memstore",
            enum=("memstore", "walstore"), runtime=False,
            desc="ObjectStore backend for OSD-lite daemons"),

@@ -563,6 +563,94 @@ def test_update_baseline_ignores_filters(tmp_path):
     assert written == committed
 
 
+# ------------------------------------------------------- send-discipline
+
+
+def test_send_discipline_per_frame_drain_fires():
+    out = lint(
+        """
+        async def _send_all(self, writer, frames):
+            for f in frames:
+                writer.write(f)
+                await writer.drain()
+        """,
+        "ceph_tpu/msg/fixture.py", only=["send-discipline"])
+    assert len(out) == 1
+    assert "per-frame" in out[0].message
+
+
+def test_send_discipline_corked_writer_allowlisted():
+    # the corked writer's drain-per-BURST loop is the one legal shape
+    out = lint(
+        """
+        async def _writer_bursts(self, dst, evt, items):
+            while True:
+                writer.write(b"".join(take_all(self)))
+                await writer.drain()
+        """,
+        "ceph_tpu/msg/fixture.py", only=["send-discipline"])
+    assert out == []
+
+
+def test_send_discipline_handshake_single_drain_clean():
+    # one frame, one drain, no loop (the auth handshake shape)
+    out = lint(
+        """
+        async def _connect(self, dst):
+            writer.write(hello)
+            await writer.drain()
+        """,
+        "ceph_tpu/msg/fixture.py", only=["send-discipline"])
+    assert out == []
+
+
+def test_send_discipline_scoped_to_msg_layer():
+    # a drain loop outside ceph_tpu/msg/ is not this rule's business
+    out = lint(
+        """
+        async def pump(writer, frames):
+            for f in frames:
+                writer.write(f)
+                await writer.drain()
+        """,
+        "ceph_tpu/cluster/fixture.py", only=["send-discipline"])
+    assert out == []
+
+
+def test_send_discipline_wal_flush_fires():
+    out = lint(
+        """
+        import os
+
+        class S:
+            def queue_transaction(self, rec):
+                self._wal.write(rec)
+                self._wal.flush()
+                os.fsync(self._wal.fileno())
+        """,
+        "ceph_tpu/store/fixture.py", only=["send-discipline"])
+    assert len(out) == 2
+    assert all("group-commit" in m for m in msgs(out))
+
+
+def test_send_discipline_committer_hook_clean():
+    out = lint(
+        """
+        import os
+
+        class S:
+            def _flush_wal(self):
+                self._wal.flush()
+                os.fsync(self._wal.fileno())
+
+            def compact(self):
+                self._wal.truncate(0)
+                os.fsync(self._wal.fileno())
+        """,
+        "ceph_tpu/store/fixture.py", only=["send-discipline"])
+    assert out == []
+
+
 # ------------------------------------------------------------ repo gate
 
 
