@@ -530,6 +530,8 @@ def config6_rados_bench(latency: float) -> dict:
                         store_kw: dict | None = None,
                         secs: float = write_secs,
                         with_reads: bool = True) -> dict:
+        from ceph_tpu.utils.buffer import STATS as BL_STATS
+
         c = TestCluster(n_osds=12, osd_conf={
             "osd_ec_batch_window": batch_window_s,
             "osd_ec_batch_target_stripes": batch_target_stripes,
@@ -567,6 +569,10 @@ def config6_rados_bench(latency: float) -> dict:
         # at client_max_inflight ops without task-per-op overhead
         comps: list = []
         seq = 0
+        # buffer-plane ledger: count flattens/zero-copy sends over the
+        # measured phases only (warmup/pool-create marshals excluded)
+        BL_STATS.reset()
+        bus_zc0 = c.bus.zero_copy_sends
         t_end = time.perf_counter() + secs
         t0 = time.perf_counter()
         while time.perf_counter() < t_end:
@@ -646,6 +652,11 @@ def config6_rados_bench(latency: float) -> dict:
         bus_bursts = c.bus.delivery_bursts
         bus_frames = c.bus.frames_delivered
         bus_fpd = c.bus.frames_per_drain
+        # buffer-plane evidence: zero-copy LocalBus deliveries (client-
+        # facing bodies NOT re-encoded per hop) and what still flattens
+        bl = BL_STATS.dump()
+        bl["bl_zero_copy_sends"] = c.bus.zero_copy_sends - bus_zc0
+        bl["bus_snapshot_delivery"] = c.bus.snapshot_delivery
         await c.stop()
         from ceph_tpu.ec import engine as ec_engine
 
@@ -684,6 +695,11 @@ def config6_rados_bench(latency: float) -> dict:
             "frames_per_drain": round(bus_fpd, 2),
             "delivery_bursts": bus_bursts,
             "frames_delivered": bus_frames,
+            # ---- buffer plane (this PR's copy-elimination evidence):
+            # bl_zero_copy_sends = snapshot-view LocalBus deliveries,
+            # bl_flattens / bl_bytes_flattened = copies still paid at
+            # sanctioned boundaries during the measured phases
+            **bl,
             "store_commits": commits,
             "store_commits_grouped": commits_grouped,
             "store_txns": store_txns,

@@ -102,8 +102,8 @@ def register(cls: type[Rule]) -> type[Rule]:
 def preload() -> None:
     """Import the built-in rule modules (registration is import-time,
     the mon/osd "plugins preload" stance)."""
-    from . import (rules_dtype, rules_lock, rules_pipeline,  # noqa: F401
-                   rules_trace, rules_wire)
+    from . import (rules_buffer, rules_dtype, rules_lock,  # noqa: F401
+                   rules_pipeline, rules_trace, rules_wire)
 
 
 # ------------------------------------------------------------ AST helpers

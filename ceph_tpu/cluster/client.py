@@ -441,7 +441,7 @@ class RadosClient:
         """Execute a compound ObjectOperation atomically on one object
         (IoCtxImpl::operate role); returns each op's output bytes."""
         reply = await self._submit(pool_id, name, op.ops)
-        return [d for _r, d in reply.outs]
+        return [bytes(d) for _r, d in reply.outs]
 
     # ------------------------------------------------------ aio window
 
@@ -560,20 +560,20 @@ class RadosClient:
     async def aio_write_full(self, pool_id: int, name, data: bytes,
                              snapc=None) -> Completion:
         return await self.aio_submit(
-            pool_id, name, [M.osd_op("writefull", data=bytes(data))],
+            pool_id, name, [M.osd_op("writefull", data=data)],
             snapc=snapc)
 
     async def aio_write(self, pool_id: int, name, offset: int,
                         data: bytes, snapc=None) -> Completion:
         return await self.aio_submit(
             pool_id, name,
-            [M.osd_op("write", offset=offset, data=bytes(data))],
+            [M.osd_op("write", offset=offset, data=data)],
             snapc=snapc)
 
     async def aio_append(self, pool_id: int, name, data: bytes,
                          snapc=None) -> Completion:
         return await self.aio_submit(
-            pool_id, name, [M.osd_op("append", data=bytes(data))],
+            pool_id, name, [M.osd_op("append", data=data)],
             snapc=snapc)
 
     async def aio_operate(self, pool_id: int, name,
@@ -677,21 +677,21 @@ class RadosClient:
     async def write_full(self, pool_id: int, name, data: bytes,
                          snapc=None) -> None:
         await self._submit(pool_id, name,
-                           [M.osd_op("writefull", data=bytes(data))],
+                           [M.osd_op("writefull", data=data)],
                            snapc=snapc)
 
     async def write(self, pool_id: int, name, offset: int,
                     data: bytes, snapc=None) -> None:
         await self._submit(
             pool_id, name,
-            [M.osd_op("write", offset=offset, data=bytes(data))],
+            [M.osd_op("write", offset=offset, data=data)],
             snapc=snapc,
         )
 
     async def append(self, pool_id: int, name, data: bytes,
                      snapc=None) -> None:
         await self._submit(pool_id, name,
-                           [M.osd_op("append", data=bytes(data))],
+                           [M.osd_op("append", data=data)],
                            snapc=snapc)
 
     async def truncate(self, pool_id: int, name, size: int,
@@ -715,7 +715,9 @@ class RadosClient:
             [M.osd_op("read", offset=offset, length=length)],
             snapid=snapid,
         )
-        return reply.outs[0][1]
+        # client API boundary: read payloads may arrive as views (wire
+        # tier); bytes() is the identity on the LocalBus zero-copy path
+        return bytes(reply.outs[0][1])
 
     async def stat(self, pool_id: int, name, snapid=None) -> int:
         reply = await self._submit(pool_id, name, [M.osd_op("stat")],
@@ -812,7 +814,7 @@ class RadosClient:
         reply = await self._submit(
             pool_id, name, [M.osd_op("getxattr", key=key.encode())]
         )
-        return reply.outs[0][1]
+        return bytes(reply.outs[0][1])
 
     async def setxattr(self, pool_id: int, name, key: str,
                        value: bytes) -> None:
@@ -880,7 +882,7 @@ class RadosClient:
         id (librados notify role, fire-and-forget acks)."""
         reply = await self._submit(
             pool_id, name,
-            [M.osd_op("notify", data=bytes(payload))],
+            [M.osd_op("notify", data=payload)],
         )
         from ..utils import denc
 
@@ -894,7 +896,7 @@ class RadosClient:
             [M.osd_op("call", key=f"{cls}.{method}".encode(),
                       data=bytes(inp))],
         )
-        return reply.outs[0][1]
+        return bytes(reply.outs[0][1])
 
 
 class ObjectOperation:
@@ -913,13 +915,13 @@ class ObjectOperation:
         return self._add("create", length=0 if exclusive else 1)
 
     def write_full(self, data: bytes):
-        return self._add("writefull", data=bytes(data))
+        return self._add("writefull", data=data)
 
     def write(self, offset: int, data: bytes):
-        return self._add("write", offset=offset, data=bytes(data))
+        return self._add("write", offset=offset, data=data)
 
     def append(self, data: bytes):
-        return self._add("append", data=bytes(data))
+        return self._add("append", data=data)
 
     def truncate(self, size: int):
         return self._add("truncate", offset=size)

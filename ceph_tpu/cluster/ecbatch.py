@@ -197,10 +197,17 @@ class ECBatcher:
                     fut.set_result(_FAILED)
 
     async def _submit(self, key: tuple, codec, cells: np.ndarray):
+        # cells pass through AS A VIEW (the zero-copy staging contract:
+        # callers hand over ownership and never mutate after submit) —
+        # the RMW path submits the (T, k, su) transpose of its
+        # shard-major staging buffer, and the host engine's shard-major
+        # flatten reads that same contiguous storage back without a
+        # copy; forcing contiguity here would re-buy the transpose copy
+        # this layout exists to kill
         loop = asyncio.get_running_loop()
         fut = loop.create_future()
         self._pending.setdefault(key, []).append(
-            (codec, np.ascontiguousarray(cells), fut, loop.time()))
+            (codec, cells, fut, loop.time()))
         self._parked += 1
         try:
             self._poke(key)

@@ -542,6 +542,14 @@ class Thrasher:
         c = self.cluster
         if self.bitrot_p > 0:
             self.plane.store_fault("ec_read_bitflip", p=self.bitrot_p)
+        # arm the buffer plane's opt-in codec-symmetry check for the
+        # whole thrash: snapshot-view delivery skips the marshal per
+        # hop, so the thrasher is where every client-facing message
+        # still proves encode -> decode -> re-encode agreement (a
+        # divergence fails the send loudly and the verdict with it)
+        bus = getattr(c, "bus", None)
+        if bus is not None and hasattr(bus, "verify_codec_symmetry"):
+            bus.verify_codec_symmetry = True
         self.workload.start()
         loop = asyncio.get_running_loop()
         t0 = loop.time()

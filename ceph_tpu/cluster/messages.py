@@ -8,43 +8,81 @@ eversion_t (version_t dominates within an epoch).
 from __future__ import annotations
 
 from ..msg.messages import Message, register_message
+from ..utils.buffer import BufferList
 from .snaps import NOSNAP
 
 PGID = "pair:i32:u32"
 EVERSION = "pair:u32:u64"
 
 
+def _lazy_txn_bl(v) -> BufferList:
+    """A store Transaction field that may still be the OBJECT as wire
+    segments: in-process (LocalBus zero-copy) it is delivered as-is and
+    never encoded; only a wire messenger pays the marshalling cost
+    here — and a Transaction carrying BufferList/view write payloads
+    marshals those as views too (encode_bl)."""
+    if isinstance(v, BufferList):
+        return v
+    if isinstance(v, (bytes, bytearray, memoryview)):
+        return BufferList(v)
+    return v.encode_bl()
+
+
 def _enc_lazy_txn(v) -> bytes:
-    """Encode a store Transaction field that may still be the OBJECT:
-    in-process (LocalBus zero-copy) it is delivered as-is and never
-    encoded; only a wire messenger pays the marshalling cost here."""
     from ..utils import denc
 
-    if not isinstance(v, (bytes, bytearray, memoryview)):
-        v = v.encode()
-    return denc.enc_bytes(bytes(v))
+    return denc.enc_bytes(bytes(_lazy_txn_bl(v)))
+
+
+def _enc_lazy_txn_bl(v, bl: BufferList) -> None:
+    from ..msg.messages import _enc_bytes_bl
+
+    _enc_bytes_bl(_lazy_txn_bl(v), bl)
+
+
+def _lazy_entries_bl(v) -> BufferList:
+    """Same stance for a log-entry list field (entry encodings are
+    memoized on the Entry, so a wire marshal reuses what the PG log
+    already produced for persistence)."""
+    from ..utils import denc
+
+    if isinstance(v, BufferList):
+        return v
+    if isinstance(v, (bytes, bytearray, memoryview)):
+        return BufferList(v)
+    out = BufferList(denc.enc_u32(len(v)))
+    for e in v:
+        out.append(e.encode())
+    return out
 
 
 def _enc_lazy_entries(v) -> bytes:
-    """Same stance for a log-entry list field."""
     from ..utils import denc
 
-    if not isinstance(v, (bytes, bytearray, memoryview)):
-        v = denc.enc_list(v, lambda e: e.encode())
-    return denc.enc_bytes(bytes(v))
+    return denc.enc_bytes(bytes(_lazy_entries_bl(v)))
+
+
+def _enc_lazy_entries_bl(v, bl: BufferList) -> None:
+    from ..msg.messages import _enc_bytes_bl
+
+    _enc_bytes_bl(_lazy_entries_bl(v), bl)
 
 
 def _dec_field_bytes(buf, off):
+    # view decode: the receiver's Transaction/Entry decode walks the
+    # view in place (receivers branch on type, so the view is consumed
+    # immediately — it never outlives the frame buffer usefully)
     from ..utils import denc
 
-    return denc.dec_bytes(buf, off)
+    return denc.dec_bytes_view(buf, off)
 
 
 #: field kinds for sub-op payloads: senders may pass the live object
 #: (Transaction / list[Entry]); wire encode marshals, local delivery
 #: ships the object. Receivers branch on type.
-LAZY_TXN = (_enc_lazy_txn, _dec_field_bytes)
-LAZY_ENTRIES = (_enc_lazy_entries, _dec_field_bytes)
+LAZY_TXN = (_enc_lazy_txn, _dec_field_bytes, _enc_lazy_txn_bl)
+LAZY_ENTRIES = (_enc_lazy_entries, _dec_field_bytes,
+                _enc_lazy_entries_bl)
 
 # op result codes (negated errno style, like the reference)
 OK = 0
@@ -235,6 +273,25 @@ def _enc_osd_op(e):
         denc.enc_list(keys, denc.enc_bytes)))
 
 
+def _enc_osd_op_bl(e, bl: BufferList) -> None:
+    """BufferList form of :func:`_enc_osd_op`: the op's ``data`` body
+    (the 4 MiB write payload) rides as a VIEW between two marshalled
+    segments instead of being copied into the op encoding."""
+    from ..utils import denc
+
+    op, offset, length, key, data, kv, keys = e
+    n = (len(data) if isinstance(data, (bytes, BufferList))
+         else len(memoryview(data).cast("B")))
+    bl.append(b"".join((
+        denc.enc_str(op), denc.enc_u64(offset),
+        denc.enc_i64(length), denc.enc_bytes(key),
+        denc.enc_u32(n))))
+    if n:
+        bl.append(data)
+    bl.append(denc.enc_map(kv, denc.enc_bytes, denc.enc_bytes)
+              + denc.enc_list(keys, denc.enc_bytes))
+
+
 def _dec_osd_op(buf, off):
     from ..utils import denc
 
@@ -242,7 +299,10 @@ def _dec_osd_op(buf, off):
     offset, off = denc.dec_u64(buf, off)
     length, off = denc.dec_i64(buf, off)
     key, off = denc.dec_bytes(buf, off)
-    data, off = denc.dec_bytes(buf, off)
+    # the data body decodes as a view over the frame buffer (the
+    # bufferlist stance); key/kv/keys stay bytes — they are compared
+    # and used as dict keys downstream
+    data, off = denc.dec_bytes_view(buf, off)
     kv, off = denc.dec_map(buf, off, denc.dec_bytes, denc.dec_bytes)
     keys, off = denc.dec_list(buf, off, denc.dec_bytes)
     return (op, offset, length, key, data, kv, keys), off
@@ -254,6 +314,14 @@ def _enc_osd_ops(v):
     return denc.enc_list(v, _enc_osd_op)
 
 
+def _enc_osd_ops_bl(v, bl: BufferList) -> None:
+    from ..utils import denc
+
+    bl.append(denc.enc_u32(len(v)))
+    for e in v:
+        _enc_osd_op_bl(e, bl)
+
+
 def _dec_osd_ops(buf, off):
     from ..utils import denc
 
@@ -263,7 +331,11 @@ def _dec_osd_ops(buf, off):
 def osd_op(op: str, offset: int = 0, length: int = -1, key: bytes = b"",
            data: bytes = b"", kv: dict | None = None,
            keys: list | None = None) -> tuple:
-    return (op, offset, length, bytes(key), bytes(data),
+    # data stays a view when the caller already holds one (bytes pass
+    # through un-copied; bytes(bytes) is the identity)
+    if not isinstance(data, (bytes, memoryview, BufferList)):
+        data = bytes(data)
+    return (op, offset, length, bytes(key), data,
             dict(kv or {}), list(keys or []))
 
 
@@ -276,12 +348,24 @@ def _enc_outs(v):
     )
 
 
+def _enc_outs_bl(v, bl: BufferList) -> None:
+    from ..msg.messages import _enc_bytes_bl
+    from ..utils import denc
+
+    bl.append(denc.enc_u32(len(v)))
+    for r, d in v:
+        bl.append(denc.enc_i32(r))
+        _enc_bytes_bl(d, bl)
+
+
 def _dec_outs(buf, off):
     from ..utils import denc
 
     def one(b, o):
         r, o = denc.dec_i32(b, o)
-        d, o = denc.dec_bytes(b, o)
+        # read payloads decode as views (the client materializes at
+        # its own API boundary if the caller needs bytes semantics)
+        d, o = denc.dec_bytes_view(b, o)
         return (r, d), o
 
     return denc.dec_list(buf, off, one)
@@ -297,7 +381,7 @@ class MOSDOp(Message):
         ("tid", "u64"),
         ("pgid", PGID),
         ("oid", "bytes"),
-        ("ops", (_enc_osd_ops, _dec_osd_ops)),
+        ("ops", (_enc_osd_ops, _dec_osd_ops, _enc_osd_ops_bl)),
         ("epoch", "u32"),  # client's map epoch at send time
         # SnapContext for writes (seq + existing snap ids, descending;
         # the selfmanaged_snap_set_write_ctx role) and the snap id reads
@@ -319,9 +403,9 @@ class MOSDOpReply(Message):
     FIELDS = (
         ("tid", "u64"),
         ("result", "i32"),
-        ("data", "bytes"),
+        ("data", "body"),
         ("size", "u64"),
-        ("outs", (_enc_outs, _dec_outs)),
+        ("outs", (_enc_outs, _dec_outs, _enc_outs_bl)),
         ("epoch", "u32"),  # responder's epoch (client refreshes on ESTALE)
     )
 
@@ -409,7 +493,7 @@ class MECSubReadReply(Message):
         ("pgid", PGID),
         ("shard", "u32"),
         ("result", "i32"),
-        ("data", "bytes"),
+        ("data", "body"),
         ("digest", "u32"),  # stored hinfo crc for the returned chunk
         ("size", "u64"),  # stored whole-object size attr
         ("attrs", "map:str:bytes"),  # user xattrs (mirrored per shard)
@@ -450,7 +534,7 @@ class MPushOp(Message):
         ("shard", "i32"),
         ("oid", "bytes"),
         ("version", EVERSION),
-        ("data", "bytes"),
+        ("data", "body"),
         ("attrs", "map:str:bytes"),
         ("epoch", "u32"),
         ("force", "u8"),
@@ -766,7 +850,7 @@ class MEnvelope(Message):
         ("src", "str"),
         ("dst", "str"),
         ("mtype", "u32"),
-        ("payload", "bytes"),
+        ("payload", "body"),
         # per-ENTITY origin signature (CephxProtocol authorizer role):
         # HMAC(src entity's key, src|dst|mtype|payload), verified by
         # the receiving NetBus — the node-level connection handshake

@@ -85,6 +85,17 @@ def _is_recovery_attr(k: str) -> bool:
 OMAP_HDR = "_oh"
 
 
+def _pad_to(arr: np.ndarray, n: int) -> np.ndarray:
+    """Zero-extend a 1-D uint8 array to ``n`` bytes (view passthrough
+    when already full — the view-friendly replacement for the old
+    bytes.ljust copies on the staging/decode paths)."""
+    if arr.size >= n:
+        return arr
+    out = np.zeros(n, dtype=np.uint8)
+    out[: arr.size] = arr
+    return out
+
+
 def enc_entries(entries: list[Entry]) -> bytes:
     return denc.enc_list(entries, lambda e: e.encode())
 
@@ -604,10 +615,12 @@ class PG:
         must land the log in THAT shard's collection, or it looks
         empty/behind after a restart and recovers needlessly (round-3
         advisor finding)."""
-        enc = self.log.encode()
+        # pre-encoded entry VIEWS, not a tail re-encode per sub-op: the
+        # BufferList shares each entry's memoized wire form and the
+        # store lands the segments at the commit boundary
         cid = self.cid if cid is None else cid
         t.truncate(cid, META_OID, 0)
-        t.write(cid, META_OID, 0, enc)
+        t.write(cid, META_OID, 0, self.log.encode_bl())
 
     def _append_and_persist(self, entries: list[Entry],
                             t: tx.Transaction) -> None:
@@ -1108,8 +1121,9 @@ class PG:
             return t
         if st8.full_replace:
             # a cls method rebuilt arbitrary facets: replace everything
+            # (t.write snapshots the mutable bytearray itself)
             t.truncate(cid, oid, 0)
-            t.write(cid, oid, 0, bytes(st8._data))
+            t.write(cid, oid, 0, st8._data)
             t.rmattrs(cid, oid)
             attrs = {ATTR_V: enc_ver(version), **st8.sys_attrs}
             for k, v in st8.xattrs().items():
@@ -1354,7 +1368,7 @@ class PG:
             ov = st.Overlay(st8.size0 if st8.exists0 else 0)
             ov.truncate(0)
             if st8._data:
-                ov.write(0, bytes(st8._data))
+                ov.write(0, st8._data)  # Overlay snapshots bytearrays
         else:
             ov = st8.ov
         old_size = st8.size0 if st8.exists0 else 0
@@ -1400,25 +1414,30 @@ class PG:
                 old_parts[s] = data[lo : lo + si.width]
 
         tlist = sorted(touched)
-        cells = np.zeros((len(tlist), k, si.su), dtype=np.uint8)
+        # Shard-major device STAGING buffer (the bufferlist seam of the
+        # RMW path): rows are shard files — (k+m, T, su), data rows
+        # first. The batcher consumes the data rows' (T, k, su)
+        # transpose VIEW, whose shard-major flatten inside the host
+        # engine reads this same contiguous buffer back — so the old
+        # ascontiguousarray transposes and the per-run tobytes copies
+        # are gone: each shard's write runs below slice contiguous
+        # (run, su) views straight out of staging into the shard
+        # transactions, and the store lands them at its own commit
+        # boundary. A zero cell's CRC equals zero_cell_crc, so no
+        # special-casing.
+        staging = np.zeros((n, len(tlist), si.su), dtype=np.uint8)
+        data_sh = staging[:k]                      # (k, T, su)
+        par_sh = staging[k:]                       # (m, T, su)
         for i, s in enumerate(tlist):
             start = s * si.width
             end = min(start + si.width, new_size)
             buf = ov.apply_range(start, end, old_parts.get(s, b""))
-            arr = np.frombuffer(buf, dtype=np.uint8)
-            cells[i].reshape(-1)[: arr.size] = arr
-        # Shard-major layout: one transpose copy gives every shard's
-        # cells as a CONTIGUOUS (T, su) block, so each write run below
-        # is one slice.tobytes() instead of a per-cell tobytes + join
-        # (the round-5 profile's dominant remaining memcpy). A zero
-        # cell's CRC equals zero_cell_crc, so no special-casing.
+            arr = _pad_to(np.frombuffer(buf, dtype=np.uint8), si.width)
+            data_sh[:, i, :] = arr.reshape(k, si.su)
         if tlist:
-            parity, fused = await osd.ec_batcher.encode_cells(codec,
-                                                              cells)
-            data_sh = np.ascontiguousarray(
-                cells.transpose(1, 0, 2))          # (k, T, su)
-            par_sh = np.ascontiguousarray(
-                parity.transpose(1, 0, 2))         # (m, T, su)
+            parity, fused = await osd.ec_batcher.encode_cells(
+                codec, data_sh.transpose(1, 0, 2))
+            par_sh[:] = parity.transpose(1, 0, 2)
             if fused is not None:
                 # device engine: the per-cell hash_info CRCs came back
                 # from the SAME fused dispatch as the parity — no
@@ -1426,18 +1445,16 @@ class PG:
                 crc_d = np.ascontiguousarray(fused[:, :k].T)   # (k, T)
                 crc_p = np.ascontiguousarray(fused[:, k:].T)   # (m, T)
             else:
-                # host engine: one multithreaded native CRC call per
-                # side (kept two-pass so the engine-economics probe
-                # stays apples-to-apples with the C++ core)
+                # host engine: ONE multithreaded native CRC batch over
+                # the whole shard-major staging (same bytes the old
+                # two-call shape hashed, same engine economics)
                 nthr = _os.cpu_count() or 1
-                crc_d = native.crc32c_batch(
-                    data_sh.reshape(-1, si.su), threads=nthr
-                ).reshape(k, len(tlist))
-                crc_p = native.crc32c_batch(
-                    par_sh.reshape(-1, si.su), threads=nthr
-                ).reshape(n - k, len(tlist))
-            nz_d = data_sh.any(axis=2)             # (k, T)
-            nz_p = par_sh.any(axis=2)              # (m, T)
+                crcs = native.crc32c_batch(
+                    staging.reshape(-1, si.su), threads=nthr
+                ).reshape(n, len(tlist))
+                crc_d, crc_p = crcs[:k], crcs[k:]
+            nz = staging.any(axis=2)               # (k+m, T)
+            nz_d, nz_p = nz[:k], nz[k:]
         shard_txns: dict[int, tx.Transaction] = {}
         hpatches: dict[int, bytes] = {}
         for g in range(n):
@@ -1456,7 +1473,7 @@ class PG:
                 t.truncate(cid, oid, new_nst * si.su)
             patch = np.zeros((len(tlist), 2), dtype="<u4")
             if tlist:
-                rows = data_sh[g] if g < k else par_sh[g - k]
+                rows = staging[g]  # (T, su) contiguous shard rows
                 crc_g = crc_d[g] if g < k else crc_p[g - k]
                 nz_g = nz_d[g] if g < k else nz_p[g - k]
             run_i = run_s = prev_s = -1
@@ -1467,8 +1484,9 @@ class PG:
                 patch[i] = (s, crc_g[i])
                 if skip or (run_i >= 0 and s != prev_s + 1):
                     if run_i >= 0:
+                        # contiguous staging view, not a tobytes copy
                         t.write(cid, oid, run_s * si.su,
-                                rows[run_i:i].tobytes())
+                                rows[run_i:i])
                         run_i = -1
                 if not skip:
                     if run_i < 0:
@@ -1476,7 +1494,7 @@ class PG:
                     prev_s = s
             if run_i >= 0:
                 t.write(cid, oid, run_s * si.su,
-                        rows[run_i:len(tlist)].tobytes())
+                        rows[run_i:len(tlist)])
             for m_ in st8.xattr_muts:
                 if m_[0] == "set":
                     t.setattr(cid, oid, USER_ATTR + m_[1], m_[2])
@@ -2004,7 +2022,7 @@ class PG:
             return await self.osd.ec_batcher.decode_cells(
                 codec, present, want_generators, surv)
         arrs = {
-            p: np.frombuffer(c.ljust(maxlen, b"\0"), dtype=np.uint8)
+            p: _pad_to(np.frombuffer(c, dtype=np.uint8), maxlen)
             for p, c in chunks.items()
         }
         positions = [codec.chunk_index(g) for g in want_generators]

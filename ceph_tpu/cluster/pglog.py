@@ -36,6 +36,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 
 from ..utils import denc
+from ..utils.buffer import BufferList
 
 ZERO = (0, 0)
 
@@ -55,20 +56,27 @@ class Entry:
     #: osd_reqid_t in pg_log_entry_t for exactly this, PGLog.cc role).
     #: ("", 0) for internal entries (clones, recovery markers).
     reqid: tuple[str, int] = ("", 0)
+    #: memoized wire form — an entry is logically immutable once
+    #: stamped, but every sub-op used to re-encode the WHOLE log tail
+    #: through it (the round-6 profile's _persist_log seam); excluded
+    #: from equality/repr
+    _enc: bytes | None = field(default=None, compare=False, repr=False)
 
     def encode(self) -> bytes:
-        return b"".join(
-            (
-                denc.enc_str(self.op),
-                denc.enc_bytes(self.oid),
-                denc.enc_u32(self.version[0]),
-                denc.enc_u64(self.version[1]),
-                denc.enc_u32(self.prior_version[0]),
-                denc.enc_u64(self.prior_version[1]),
-                denc.enc_str(self.reqid[0]),
-                denc.enc_u64(self.reqid[1]),
+        if self._enc is None:
+            self._enc = b"".join(
+                (
+                    denc.enc_str(self.op),
+                    denc.enc_bytes(self.oid),
+                    denc.enc_u32(self.version[0]),
+                    denc.enc_u64(self.version[1]),
+                    denc.enc_u32(self.prior_version[0]),
+                    denc.enc_u64(self.prior_version[1]),
+                    denc.enc_str(self.reqid[0]),
+                    denc.enc_u64(self.reqid[1]),
+                )
             )
-        )
+        return self._enc
 
     @classmethod
     def decode(cls, buf: bytes, off: int = 0) -> tuple["Entry", int]:
@@ -124,14 +132,22 @@ class PGLog:
             final[e.oid] = e
         return final
 
+    def encode_bl(self) -> BufferList:
+        """Wire/disk form as views over the memoized entry encodings:
+        persisting the log after an append costs one small header build
+        plus len(entries) reference appends — not a re-encode of every
+        entry per sub-op (the _persist_log seam)."""
+        out = BufferList(b"".join((
+            denc.enc_u32(self.tail[0]),
+            denc.enc_u64(self.tail[1]),
+            denc.enc_u32(len(self.entries)),
+        )))
+        for e in self.entries:
+            out.append(e.encode())
+        return out
+
     def encode(self) -> bytes:
-        return b"".join(
-            (
-                denc.enc_u32(self.tail[0]),
-                denc.enc_u64(self.tail[1]),
-                denc.enc_list(self.entries, Entry.encode),
-            )
-        )
+        return bytes(self.encode_bl())
 
     @classmethod
     def decode(cls, buf: bytes, off: int = 0) -> tuple["PGLog", int]:

@@ -145,9 +145,14 @@ class Overlay:
     # ------------------------------------------------------------- ops
 
     def write(self, offset: int, data: bytes) -> None:
-        if not data:
+        if not len(data):
             return
-        self._insert(offset, bytes(data))
+        if not isinstance(data, (bytes, memoryview)):
+            # mutable (bytearray) or array storage: snapshot; immutable
+            # payloads and read-only views ride the extent list as-is
+            # (the client's write body lands here un-copied)
+            data = bytes(data)
+        self._insert(offset, data)
         self.size = max(self.size, offset + len(data))
 
     def zero(self, offset: int, length: int) -> None:

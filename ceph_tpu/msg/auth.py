@@ -73,7 +73,9 @@ class Authenticator:
 
     @staticmethod
     def parse_hello(payload: bytes) -> tuple[str, bytes, str]:
-        entity, _, rest = payload.partition(b"\0")
+        # handshake cold path: frames decode payloads as views now,
+        # and bytes methods below want real bytes
+        entity, _, rest = bytes(payload).partition(b"\0")
         if len(rest) < 16:
             raise AuthError("malformed hello")
         nonce = rest[:16]

@@ -15,11 +15,14 @@ reference's lossy client policy, retry/resend is an upper-layer concern
 from __future__ import annotations
 
 import asyncio
+import os
 from typing import Awaitable, Callable
 
 from ..utils import denc
+from ..utils.buffer import BufferList
 from .auth import AuthError
-from .frames import Frame, FrameError, IncompleteFrame, decode_frame, encode_frame
+from .frames import (Frame, FrameError, IncompleteFrame, decode_frame,
+                     encode_frame, encode_frame_bl)
 from .messages import Message, decode_message
 
 Dispatcher = Callable[[str, Message], Awaitable[None]]
@@ -50,11 +53,24 @@ def _init_zero_copy() -> None:
 class LocalBus:
     """In-process router for cluster-free tests (direct_messenger role).
 
-    Client-facing messages are encoded and decoded back on every send,
-    so codec symmetry is exercised and receivers never share mutable
-    state with senders (the client RETAINS and mutates its MOSDOp for
-    resends). The frame layer (length prefix + CRC) is skipped for all
-    local sends: framing guards a byte STREAM, which does not exist
+    Client-facing messages are delivered as SNAPSHOT VIEWS
+    (Message.snapshot): an isolated structural copy that shares payload
+    storage — receivers never share mutable state with senders (the
+    client RETAINS and mutates its MOSDOp for resends; the snapshot
+    carries send-time field values), but a 4 MiB write body is passed
+    by reference instead of paying an encode+decode per hop (the
+    round-6 profile's top seam). Codec symmetry — every message class
+    still round-tripping through its wire form — is no longer
+    exercised for free on each send, so it became an OPT-IN check:
+    arming ``verify_codec_symmetry`` re-encodes every snapshot
+    delivery and fails the send on any encode/decode/snapshot
+    disagreement; the thrasher arms it for the whole thrash
+    (cluster/faults.py), so the stance change stays validated under
+    faults. ``CEPH_TPU_BUS_SNAPSHOT=0`` restores the legacy
+    marshal-per-hop path (the bench A/B lever).
+
+    The frame layer (length prefix + CRC) is skipped for all local
+    sends: framing guards a byte STREAM, which does not exist
     in-process. Internal sub-op traffic (EC shard writes/reads,
     replication sub-ops, recovery pushes — ZERO_COPY_TYPES) is
     delivered BY REFERENCE: those messages are constructed at the send
@@ -94,6 +110,41 @@ class LocalBus:
         self._drain_scheduled: set[str] = set()
         self.frames_delivered = 0
         self.delivery_bursts = 0
+        # buffer-plane delivery mode: snapshot views by default, the
+        # legacy encode+decode per hop behind the env lever (bench A/B)
+        self.snapshot_delivery = (
+            os.environ.get("CEPH_TPU_BUS_SNAPSHOT", "1") != "0")
+        #: opt-in codec-symmetry re-encode check (armed by the
+        #: thrasher): every snapshot delivery also round-trips through
+        #: the wire codec and must agree with itself and the snapshot
+        self.verify_codec_symmetry = False
+        self.zero_copy_sends = 0      # snapshot deliveries (no marshal)
+        self.codec_symmetry_checks = 0
+
+    def _snapshot_delivery(self, msg: Message) -> Message:
+        """One client-facing delivery copy: a snapshot view, with the
+        opt-in re-encode check when armed."""
+        if not self.snapshot_delivery:
+            return decode_message(msg.TYPE, msg.encode())
+        snap = msg.snapshot()
+        self.zero_copy_sends += 1
+        if self.verify_codec_symmetry:
+            self.codec_symmetry_checks += 1
+            enc = msg.encode()
+            if bytes(msg.encode_bl()) != enc:
+                raise FrameError(
+                    f"{type(msg).__name__}: encode_bl() disagrees "
+                    "with encode()")
+            dec = decode_message(msg.TYPE, enc)
+            if dec != snap:
+                raise FrameError(
+                    f"{type(msg).__name__}: wire round-trip disagrees "
+                    "with snapshot view")
+            if dec.encode() != enc:
+                raise FrameError(
+                    f"{type(msg).__name__}: re-encode of decoded "
+                    "message is not byte-identical")
+        return snap
 
     @property
     def frames_per_drain(self) -> float:
@@ -119,7 +170,7 @@ class LocalBus:
         if msg.TYPE in ZERO_COPY_TYPES:
             decoded = msg
         else:
-            decoded = decode_message(msg.TYPE, msg.encode())
+            decoded = self._snapshot_delivery(msg)
         sender = src
         plan = self.faults.plan(src, dst)
         if plan is None:
@@ -136,9 +187,9 @@ class LocalBus:
         # manage exactly that)
         for i, delay in enumerate(plan):
             if i and msg.TYPE not in ZERO_COPY_TYPES:
-                # duplicates get their own decode: two deliveries must
-                # never share one mutable message object
-                decoded = decode_message(msg.TYPE, msg.encode())
+                # duplicates get their own snapshot: two deliveries
+                # must never share one mutable message object
+                decoded = self._snapshot_delivery(msg)
             if delay > 0:
                 # injected latency/reorder bypasses the cork: per-pair
                 # FIFO is intentionally broken — that is the fault
@@ -470,8 +521,8 @@ class TcpMessenger:
                 # snapshot NOW: the sender may retain and mutate the
                 # message (the client's MOSDOp resend path) — the
                 # delayed copy must carry send-time state, like
-                # LocalBus's decode-at-send does
-                snap = decode_message(msg.TYPE, msg.encode())
+                # LocalBus's snapshot-at-send does
+                snap = msg.snapshot()
                 task = asyncio.get_running_loop().create_task(
                     self._send_delayed(dst, snap, delay, copies))
                 self._bg.add(task)
@@ -496,15 +547,15 @@ class TcpMessenger:
         task, in queue order, because both are stateful per
         connection. A connect/write failure of the burst carrying this
         message surfaces as SendError to exactly this caller."""
-        payload = denc.enc_str(self.name) + msg.encode()
+        payload = msg.encode_bl(BufferList(denc.enc_str(self.name)))
         flags = 0
         if (self.compress_threshold is not None
                 and len(payload) >= self.compress_threshold):
             import zlib
 
-            packed = zlib.compress(payload, 1)
+            packed = zlib.compress(bytes(payload), 1)
             if len(packed) < len(payload):
-                payload, flags = packed, self.FLAG_COMPRESSED
+                payload, flags = BufferList(packed), self.FLAG_COMPRESSED
         fut = asyncio.get_running_loop().create_future()
         self._sendq.setdefault(dst, []).append(
             (msg.TYPE, payload, flags, copies, fut))
@@ -571,18 +622,29 @@ class TcpMessenger:
                     continue
                 self._conns[dst] = conn
             writer, auth, sess = conn
-            parts: list[bytes] = []
+            parts: list = []
+            nframes = 0
             for mtype, payload, flags, copies, _fut in items:
+                # one frame build per logical message: payload segments
+                # ride as views from enqueue to here, and the plain
+                # path hands them to the socket join directly — the
+                # ONLY whole-payload copy left is the kernel write.
+                # Signed/secure modes need the flat frame (HMAC/GCM
+                # consume one buffer); that flatten is their boundary.
+                wire_bl = encode_frame_bl(Frame(mtype, payload, flags))
+                nframes += copies
                 for _copy in range(copies):
-                    wire = encode_frame(Frame(mtype, payload, flags))
                     if sess is not None:
                         # secure mode: GCM supersedes HMAC; each copy
                         # gets its own counter nonce (a byte-identical
                         # replayed record would be rejected, rightly)
-                        wire = sess.encrypt(wire)
+                        parts.append(sess.encrypt(bytes(wire_bl)))
                     elif auth is not None:
-                        wire += auth.sign(wire)
-                    parts.append(wire)
+                        wire = bytes(wire_bl)
+                        parts.append(wire)
+                        parts.append(auth.sign(wire))
+                    else:
+                        parts.extend(wire_bl.segments())
             try:
                 writer.write(b"".join(parts))
                 await writer.drain()
@@ -591,7 +653,7 @@ class TcpMessenger:
                 self._fail_burst(items,
                                  SendError(f"send to {dst} failed: {e}"))
                 continue
-            self.frames_sent += len(parts)
+            self.frames_sent += nframes
             self.drains += 1
             for *_frame, fut in items:
                 if not fut.done():

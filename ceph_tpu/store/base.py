@@ -15,7 +15,31 @@ import time
 import traceback
 from typing import Callable, Iterable
 
+from ..utils.buffer import BufferList
 from . import transaction as tx
+
+
+def write_payload(dst: bytearray, off: int, data) -> None:
+    """Land a write payload (bytes / memoryview / BufferList) into the
+    store's bytearray at ``off``: BufferList segments write directly at
+    their offsets — the store boundary never joins them first."""
+    if isinstance(data, BufferList):
+        for seg in data.segments():
+            n = len(seg)
+            dst[off : off + n] = seg
+            off += n
+    else:
+        dst[off : off + len(data)] = data
+
+
+def payload_bytearray(data) -> bytearray:
+    """A fresh bytearray holding the payload (the replacement-object
+    fast path): one allocation, segments written in place."""
+    if isinstance(data, BufferList):
+        out = bytearray(len(data))
+        write_payload(out, 0, data)
+        return out
+    return bytearray(data)
 
 
 #: reserved oid prefix for snapshot clone objects (single source of
@@ -384,7 +408,7 @@ class ObjectStore:
                 # shard-rewrite shape pays this per sub-op, and the
                 # clone was the write path's dominant memcpy
                 o = Obj()
-                o.data = bytearray(a["data"])
+                o.data = payload_bytearray(a["data"])
                 o.xattrs = dict(old.xattrs)
                 o.omap = dict(old.omap)
                 o.omap_header = old.omap_header
@@ -435,11 +459,15 @@ class ObjectStore:
                 # no zero-fill of bytes the data is about to cover
                 if off > len(o.data):
                     o.data.extend(b"\0" * (off - len(o.data)))
-                o.data += a["data"]
+                if isinstance(a["data"], BufferList):
+                    for seg in a["data"].segments():
+                        o.data += seg
+                else:
+                    o.data += a["data"]
             else:
                 if len(o.data) < end:
                     o.data.extend(b"\0" * (end - len(o.data)))
-                o.data[off:end] = a["data"]
+                write_payload(o.data, off, a["data"])
         elif op.code == tx.OP_ZERO:
             end = a["offset"] + a["length"]
             if len(o.data) < end:

@@ -435,6 +435,12 @@ class _Txc:
     # ----------------------------------------------------------- data ops
 
     def write_range(self, onode: Onode, offset: int, data: bytes) -> None:
+        if not isinstance(data, bytes):
+            # view/BufferList payloads materialize HERE: the blob layer
+            # slices, compresses and checksums per block, which is this
+            # store's kv/COW boundary — the one flatten the buffer
+            # plane budgets for
+            data = bytes(data)
         if not data:
             onode.size = max(onode.size, offset)
             self.grow(onode, onode.size)

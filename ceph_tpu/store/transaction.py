@@ -11,6 +11,8 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Any, Iterable
 
+from ..utils.buffer import BufferList, as_view
+
 # opcodes (names mirror Transaction.h)
 OP_TOUCH = "touch"
 OP_WRITE = "write"
@@ -58,8 +60,13 @@ class Transaction:
     def touch(self, cid: str, oid: bytes):
         return self._add(OP_TOUCH, cid, oid)
 
-    def write(self, cid: str, oid: bytes, offset: int, data: bytes):
-        return self._add(OP_WRITE, cid, oid, offset=offset, data=bytes(data))
+    def write(self, cid: str, oid: bytes, offset: int, data):
+        """``data`` may be bytes, a memoryview, a contiguous ndarray,
+        or a BufferList — views ride the transaction un-copied (the
+        bufferlist stance); stores materialize at their own durability
+        boundary. A bytearray is snapshotted (its owner may mutate)."""
+        return self._add(OP_WRITE, cid, oid, offset=offset,
+                         data=_as_payload(data))
 
     def zero(self, cid: str, oid: bytes, offset: int, length: int):
         return self._add(OP_ZERO, cid, oid, offset=offset, length=length)
@@ -167,16 +174,36 @@ class Transaction:
 
     def encode(self) -> bytes:
         """Explicit LE binary form (the denc role) for WAL/wire."""
+        return bytes(self.encode_bl())
+
+    def encode_bl(self, bl: BufferList | None = None) -> BufferList:
+        """Wire/WAL form as a BufferList: op headers and small args
+        marshal into byte segments, OP_WRITE payloads ride as views —
+        the flatten happens at the WAL fsync / socket boundary, not
+        here."""
         from ..utils import denc
 
-        parts = [denc.enc_u32(len(self.ops))]
+        if bl is None:
+            bl = BufferList()
+        bl.append(denc.enc_u32(len(self.ops)))
         for op in self.ops:
-            parts.append(denc.enc_str(op.code))
-            parts.append(denc.enc_str(op.cid))
-            parts.append(denc.enc_bytes(op.oid if op.oid is not None else b""))
-            parts.append(denc.enc_u8(op.oid is not None))
-            parts.append(_encode_args(op.code, op.args))
-        return b"".join(parts)
+            head = b"".join((
+                denc.enc_str(op.code),
+                denc.enc_str(op.cid),
+                denc.enc_bytes(op.oid if op.oid is not None else b""),
+                denc.enc_u8(op.oid is not None),
+            ))
+            if op.code == OP_WRITE:
+                # schema order (offset, data): the data body is a view
+                data = op.args["data"]
+                n = len(data)
+                bl.append(head + denc.enc_u64(op.args["offset"])
+                          + denc.enc_u32(n))
+                if n:
+                    bl.append(data)
+            else:
+                bl.append(head + _encode_args(op.code, op.args))
+        return bl
 
     @classmethod
     def decode(cls, buf: bytes, off: int = 0) -> tuple["Transaction", int]:
@@ -192,6 +219,17 @@ class Transaction:
             args, off = _decode_args(code, buf, off)
             t.ops.append(Op(code, cid, oid if has_oid else None, args))
         return t, off
+
+
+def _as_payload(data):
+    """Normalize a write payload to something with byte ``len()`` that
+    the transaction can hold without copying: bytes and BufferList pass
+    through, everything else goes through the buffer plane's one
+    normalization (flat read-only view; bytearray snapshotted;
+    non-contiguous storage rejected at the producer)."""
+    if isinstance(data, (bytes, BufferList)):
+        return data
+    return as_view(data)
 
 
 # arg schemas: name -> (encoder, decoder) pairs per op code

@@ -91,6 +91,18 @@ def dec_bytes(buf, off):
     return bytes(buf[off : off + n]), off + n
 
 
+def dec_bytes_view(buf, off):
+    """Zero-copy variant of :func:`dec_bytes` for payload BODIES (the
+    bufferlist stance): returns a read-only memoryview over ``buf``
+    instead of a copied ``bytes``. The view pins ``buf`` alive; cold
+    paths call ``bytes()`` on it at their own boundary."""
+    n, off = dec_u32(buf, off)
+    if off + n > len(buf):
+        raise DecodeError(f"short bytes at {off} (want {n})")
+    mv = buf if isinstance(buf, memoryview) else memoryview(buf)
+    return mv[off : off + n].toreadonly(), off + n
+
+
 def enc_str(s: str) -> bytes:
     return enc_bytes(s.encode())
 

@@ -103,6 +103,7 @@ def test_multiprocess_full_restart_durability(tmp_path):
 
 def test_multiprocess_cephx_secure(tmp_path):
     """The same tier with cephx auth + AES-GCM secure wire on."""
+    pytest.importorskip("cryptography")
     async def t():
         c = await make(tmp_path, auth=True, secure=True)
         try:

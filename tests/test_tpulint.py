@@ -651,6 +651,71 @@ def test_send_discipline_committer_hook_clean():
     assert out == []
 
 
+# ----------------------------------------------------- buffer-discipline
+
+
+def test_buffer_discipline_payload_coercion_fires():
+    out = lint(
+        """
+        def ship(conn, payload):
+            wire = bytes(payload)
+            conn.send(wire)
+        """,
+        "ceph_tpu/msg/fixture.py", only=["buffer-discipline"])
+    assert len(out) == 1
+    assert "payload coercion" in out[0].message
+
+
+def test_buffer_discipline_tobytes_fires_in_cluster_hot_path():
+    out = lint(
+        """
+        def stage(t, cid, oid, rows):
+            t.write(cid, oid, 0, rows.tobytes())
+        """,
+        "ceph_tpu/cluster/fixture.py", only=["buffer-discipline"])
+    assert len(out) == 1
+    assert ".tobytes()" in out[0].message
+
+
+def test_buffer_discipline_identity_and_alloc_clean():
+    # oid/name coercions and size allocations are not payload copies
+    out = lint(
+        """
+        def route(name, n):
+            oid = bytes(name)
+            pad = bytes(16)
+            return oid, pad
+        """,
+        "ceph_tpu/msg/fixture.py", only=["buffer-discipline"])
+    assert out == []
+
+
+def test_buffer_discipline_flatten_boundary_clean():
+    # the buffer plane's own flatten entry points may materialize
+    out = lint(
+        """
+        class BL:
+            def flatten(self, payload):
+                return bytes(payload)
+
+        def _send_now(self, payload):
+            return bytes(payload)
+        """,
+        "ceph_tpu/msg/fixture.py", only=["buffer-discipline"])
+    assert out == []
+
+
+def test_buffer_discipline_scoped_to_hot_paths():
+    # control-plane / services code is out of scope
+    out = lint(
+        """
+        def archive(payload):
+            return bytes(payload)
+        """,
+        "ceph_tpu/services/fixture.py", only=["buffer-discipline"])
+    assert out == []
+
+
 # ------------------------------------------------------------ repo gate
 
 
