@@ -449,7 +449,7 @@ def config5_straw2(latency: float) -> dict:
     xs = rng.integers(0, 2**32, chunk * (nchunks + 1), dtype=np.uint32)
     xs_d = jnp.asarray(xs)
 
-    with jax.enable_x64():
+    with crush_ops.enable_x64():
         warm = crush_ops._jit_straw2(
             items_d, items_d, weights_d, xs_d[:chunk], jnp.uint32(0)
         )
@@ -822,6 +822,204 @@ def config7_rbd_cache(_latency: float) -> dict:
     return asyncio.run(run_bench())
 
 
+def config8_multichip(_latency: float) -> dict:
+    """Multi-chip config 6 (ROADMAP "multi-chip data plane"): the SAME
+    client -> OSD -> store -> EC pipeline as config 6, served over the
+    parallel/ mesh — batched stripes land device-resident, the fused
+    encode+CRC runs sharded so each chip produces the shard rows it
+    owns (zero host gathers in the write phase, counter-proven), and
+    the payload reports per-chip stripe occupancy plus scaling vs the
+    1-chip run of the same workload.
+
+    Runs in a SUBPROCESS: XLA parses the forced-host-device flags once
+    per process, so the mesh platform must be pinned before any
+    backend init — the parent's chip/tunnel backend stays untouched.
+    The payload keeps the MULTICHIP trajectory shape
+    (n_devices / rc / ok / skipped / tail) with the measured detail
+    alongside."""
+    import subprocess
+
+    n = int(os.environ.get("CEPH_TPU_BENCH_MESH_DEVICES", "8"))
+    width = int(os.environ.get("CEPH_TPU_BENCH_MESH_WIDTH", "2"))
+    cmd = [sys.executable, os.path.abspath(__file__),
+           "--multichip-child", str(n), str(width)]
+    out = {"n_devices": n, "mesh_width": width, "rc": 0, "ok": False,
+           "skipped": False, "tail": ""}
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=900)
+    except subprocess.TimeoutExpired as e:
+        out["rc"] = -1
+        out["tail"] = ((e.stderr or b"").decode("utf-8", "replace")
+                       if isinstance(e.stderr, bytes)
+                       else (e.stderr or ""))[-400:]
+        return out
+    out["rc"] = proc.returncode
+    err_lines = (proc.stderr or "").strip().splitlines()
+    out["tail"] = err_lines[-1][-400:] if err_lines else ""
+    if proc.returncode != 0:
+        out["tail"] = "\n".join(err_lines[-6:])[-800:]
+        return out
+    try:
+        detail = json.loads((proc.stdout or "").strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        out["tail"] = f"unparseable child stdout: {proc.stdout[-200:]!r}"
+        return out
+    # the bar: the mesh actually ENGAGED (a degraded/misconfigured
+    # platform would serve single-device with trivially-zero gathers),
+    # the write phase gathered nothing, and parity is byte-identical
+    write_phase = detail.get("multichip", {}).get("write_phase", {})
+    out["ok"] = (bool(detail.get("parity_ok"))
+                 and write_phase.get("mesh_encode_dispatches", 0) > 0
+                 and write_phase.get("mesh_host_gathers", 1) == 0)
+    out.update(detail)
+    return out
+
+
+def _multichip_child(n: int, width: int) -> int:
+    """Config 8's measured body (fresh process, forced n-device host
+    platform when no real multi-chip backend is available). Prints ONE
+    JSON line on stdout."""
+    from ceph_tpu import parallel
+
+    parallel.pin_virtual_cpu(n)
+    # the mesh IS the engine under test: the auto probe would pick the
+    # host C++ core on the virtual-CPU stand-in and measure nothing
+    os.environ["CEPH_TPU_EC_ENGINE"] = "device"
+
+    import asyncio
+
+    from ceph_tpu.cluster.ecbatch import ECBatcher
+    from ceph_tpu.cluster.vstart import TestCluster
+    from ceph_tpu.ec import load_codec
+    from ceph_tpu.parallel import runtime
+    from ceph_tpu.placement.osdmap import Pool
+    from ceph_tpu.utils import config as cfg
+
+    obj_bytes = 4 << 20
+    concurrency = 16
+    secs = 4.0
+    base_conf = {
+        "osd_ec_batch_window": 0.01,
+        "osd_ec_batch_target_stripes": 48,
+        "osd_op_concurrency": 32,
+    }
+    mesh_conf = {
+        **base_conf,
+        "osd_ec_mesh_devices": n,
+        "osd_ec_mesh_width": width,
+        "parallel_repair_mode": "allgather",
+    }
+
+    async def run_pipeline(osd_conf: dict) -> dict:
+        c = TestCluster(n_osds=12, osd_conf=osd_conf)
+        await c.start()
+        c.client.op_timeout = 120.0
+        c.client.conf.set("client_max_inflight", concurrency)
+        await c.client.create_pool(Pool(
+            id=2, name="bench8", size=11, min_size=9, pg_num=16,
+            crush_rule=1, type="erasure",
+            ec_profile={"plugin": "rs_tpu", "k": "8", "m": "3",
+                        "stripe_unit": "65536", "backend": "device"}))
+        await c.wait_active(30)
+        payload = np.random.default_rng(5).integers(
+            0, 256, obj_bytes, dtype=np.uint8).tobytes()
+        await c.client.write_full(2, "warm", payload)  # compile outside
+        runtime.STATS.reset()
+        comps = []
+        seq = 0
+        t_end = time.perf_counter() + secs
+        t0 = time.perf_counter()
+        while time.perf_counter() < t_end:
+            comps.append(await c.client.aio_write_full(
+                2, f"b-{seq}", payload))
+            seq += 1
+        await c.client.writes_wait()
+        dt_w = time.perf_counter() - t0
+        for comp in comps:
+            comp.result()
+        # the write-phase mesh ledger, snapshotted BEFORE reads: the
+        # acceptance bar is mesh_host_gathers == 0 here
+        write_stats = runtime.STATS.dump()
+        got = await c.client.read(2, "b-0")
+        assert got == payload
+        mesh_dispatches = 0
+        for osd in c.osds:
+            if osd is None:
+                continue
+            d = osd.perf.dump()
+            mesh_dispatches += int(d.get("ec_mesh_encode_dispatches", 0))
+        await c.stop()
+        return {
+            "objects": seq,
+            "write_mib_s": round(seq * obj_bytes / dt_w / 2**20, 1),
+            "write_ops_s": round(seq / dt_w, 2),
+            "osd_mesh_encode_dispatches": mesh_dispatches,
+            "write_phase": write_stats,
+        }
+
+    def parity_probe() -> dict:
+        """Byte-identical proof: the SAME random stripes through the
+        mesh batcher and the single-device batcher must produce
+        identical parity, CRCs, and decode output (both combine
+        strategies)."""
+        rng = np.random.default_rng(11)
+        cells = rng.integers(0, 256, (13, 8, 4096), dtype=np.uint8)
+        codec = load_codec({"plugin": "rs_tpu", "k": "8", "m": "3",
+                            "backend": "device"})
+
+        async def probe(mode: str) -> tuple:
+            conf = cfg.proxy()
+            conf.apply({**({"osd_ec_mesh_devices": n,
+                            "osd_ec_mesh_width": width,
+                            "parallel_repair_mode": mode}
+                           if mode != "single" else {})})
+            b = ECBatcher(conf=conf)
+            parity, crcs = await b.encode_cells(codec, cells)
+            every = np.concatenate([cells, parity], axis=1)
+            present = (0, 2, 3, 4, 5, 6, 8, 9)  # lost 1, 7, 10
+            surv = np.ascontiguousarray(every[:, list(present), :])
+            dec = await b.decode_cells(codec, present, (1, 7, 10), surv)
+            return parity, crcs, dec
+
+        single = asyncio.run(probe("single"))
+        ok = True
+        for mode in ("allgather", "psum_bits"):
+            got = asyncio.run(probe(mode))
+            ok = ok and all((a == b).all() for a, b in zip(single, got))
+        return {"parity_ok": ok,
+                "parity_stripes": int(cells.shape[0]),
+                "parity_modes": ["allgather", "psum_bits"]}
+
+    import jax
+
+    mesh = asyncio.run(run_pipeline(mesh_conf))
+    runtime.STATS.reset()
+    runtime.reset_meshes()
+    single = asyncio.run(run_pipeline(base_conf))
+    detail = {
+        "n_devices": n,
+        "mesh": {"stripe": n // width, "width": width},
+        "platform": jax.default_backend(),
+        "object_bytes": obj_bytes,
+        "concurrency": concurrency,
+        "stripe_unit": 65536,
+        "multichip": mesh,
+        "single_device": single,
+        "scaling_vs_1chip": round(
+            mesh["write_mib_s"] / single["write_mib_s"], 3)
+        if single["write_mib_s"] else 0.0,
+        **parity_probe(),
+    }
+    print(json.dumps(detail))
+    print(f"config8 ok: mesh={{'stripe': {n // width}, "
+          f"'width': {width}}} write {mesh['write_mib_s']} MiB/s "
+          f"(1-chip {single['write_mib_s']}), gathers "
+          f"{mesh['write_phase']['mesh_host_gathers']}",
+          file=sys.stderr)
+    return 0
+
+
 def main() -> None:
     _progress("measuring tunnel latency ...")
     latency = measure_latency()
@@ -835,6 +1033,7 @@ def main() -> None:
         ("5_straw2_1K_osds", config5_straw2),
         ("6_rados_bench_ec_k8m3_4MiB", config6_rados_bench),
         ("7_rbd_object_cacher_64KiB_reads", config7_rbd_cache),
+        ("8_multichip_ec_k8m3_4MiB", config8_multichip),
     ):
         _progress(f"{name} ...")
         result["configs"][name] = fn(latency)
@@ -846,4 +1045,8 @@ def main() -> None:
 
 
 if __name__ == "__main__":
+    if len(sys.argv) >= 2 and sys.argv[1] == "--multichip-child":
+        sys.exit(_multichip_child(int(sys.argv[2]),
+                                  int(sys.argv[3])
+                                  if len(sys.argv) > 3 else 1))
     main()

@@ -103,7 +103,7 @@ def preload() -> None:
     """Import the built-in rule modules (registration is import-time,
     the mon/osd "plugins preload" stance)."""
     from . import (rules_buffer, rules_dtype, rules_lock,  # noqa: F401
-                   rules_pipeline, rules_trace, rules_wire)
+                   rules_mesh, rules_pipeline, rules_trace, rules_wire)
 
 
 # ------------------------------------------------------------ AST helpers

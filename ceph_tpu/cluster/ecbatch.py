@@ -23,6 +23,19 @@ This module closes that gap NIC-interrupt-coalescing style:
   (B, k', su) rebuild batches through the same bucket/pow2-pad
   machinery instead of one ``codec.decode`` per object; wanted parity
   rows fold into the recovery matrix host-side (one stacked matmul).
+- **Mesh mode** (``osd_ec_mesh_devices`` > 1, parallel/runtime.py).
+  Each bucket's staging batch is pinned device-resident under a
+  (stripe, width) mesh — stripes land sharded via one device_put, the
+  fused encode+CRC dispatch runs jitted UNDER the mesh so every shard
+  row's cells and CRCs are produced on the chip that owns them, and
+  results come back through per-device shard views
+  (``shard_rows_to_host``), never a whole-array host gather
+  (``runtime.STATS.host_gathers`` proves it). ``parallel_repair_mode``
+  (off/allgather/psum_bits) additionally routes the decode side
+  through shard_comm's distributed GF matmul: recovery partials
+  combine via mesh collectives instead of messenger fan-in. Both mesh
+  paths are byte-identical to the single-device dispatch and degrade
+  to it when the platform cannot supply the mesh.
 
 Buckets are keyed by a stable codec *profile* tuple, never ``id(codec)``
 — a GC'd codec's address can be reused by a different one, and two
@@ -96,6 +109,12 @@ class ECBatcher:
         #: optional FaultInjector (the owning OSD's): site "ec_batch"
         #: fails a dispatch, exercising the fail-closed isolation path
         self.fault = fault
+        #: serving-mesh resolution state: resolved lazily on the first
+        #: device-engine dispatch (jax/device init must not ride the
+        #: daemon constructor) and cached — including the None of a
+        #: platform that cannot supply the mesh (graceful degrade)
+        self._mesh_resolved = False
+        self._mesh_cached = None
 
     @staticmethod
     def declare_counters(perf) -> None:
@@ -114,6 +133,12 @@ class ECBatcher:
         perf.add_u64_counter("ec_batch_isolated",
                              "stripe-groups that recovered via "
                              "per-item isolation after a batch failure")
+        perf.add_u64_counter("ec_mesh_encode_dispatches",
+                             "fused encode+CRC dispatches run sharded "
+                             "under the device mesh")
+        perf.add_u64_counter("ec_mesh_decode_dispatches",
+                             "decode/repair dispatches run as mesh "
+                             "collectives (parallel_repair_mode)")
         perf.add_u64_counter("ec_decode_batches",
                              "batched EC decode dispatches")
         perf.add_histogram("ec_decode_stripes",
@@ -141,6 +166,35 @@ class ECBatcher:
             return float(self.conf["osd_ec_batch_window"])
         except Exception:
             return 0.0
+
+    def _repair_mode(self) -> str:
+        if self.conf is None:
+            return "off"
+        try:
+            mode = str(self.conf["parallel_repair_mode"])
+        except Exception:
+            return "off"
+        return mode if mode in ("allgather", "psum_bits") else "off"
+
+    def mesh(self):
+        """The serving mesh this batcher stages onto, or None (single-
+        device path). Resolved once from the osd_ec_mesh_* knobs via
+        parallel/runtime.py — the process-level cache means every OSD
+        in a test cluster shares one mesh, like chips on a host."""
+        if not self._mesh_resolved:
+            n = w = 0
+            if self.conf is not None:
+                try:
+                    n = int(self.conf["osd_ec_mesh_devices"])
+                    w = int(self.conf["osd_ec_mesh_width"])
+                except Exception:
+                    n = 0
+            if n > 1:
+                from ..parallel import runtime
+
+                self._mesh_cached = runtime.serving_mesh(n, max(1, w))
+            self._mesh_resolved = True
+        return self._mesh_cached
 
     # ------------------------------------------------------- submission
 
@@ -402,21 +456,25 @@ class ECBatcher:
     # OSD for ~0.5 s per batch)
 
     @staticmethod
-    def _pow2_pad(batch: np.ndarray) -> np.ndarray:
-        """Pad the batch axis to a power of two: jit specializes per
-        shape, and on a tunnel-attached chip each fresh batch size
-        costs a ~2 s compile — pow2 bucketing caps that at
-        log2(max batch) compiles (zero stripes encode/decode to zero
-        cells and are sliced away by the caller)."""
+    def _pow2_pad(batch: np.ndarray, mesh=None) -> np.ndarray:
+        """Pad the batch axis to the jit shape-bucketing target: jit
+        specializes per shape, and on a tunnel-attached chip each
+        fresh batch size costs a ~2 s compile — pow2 bucketing caps
+        that at log2(max batch) compiles (zero stripes encode/decode
+        to zero cells and are sliced away by the caller). With a mesh,
+        the SAME single pad also lands on a stripe-axis-divisible
+        shape (parallel.pad_batch_pow2 — padding twice would
+        double-pad)."""
+        from ..parallel import pad_batch_pow2
+
         n = len(batch)
-        target = 1 << max(0, (n - 1)).bit_length()
+        target = pad_batch_pow2(n, mesh)
         if target == n:
             return batch
         pad = np.zeros((target - n,) + batch.shape[1:], dtype=batch.dtype)
         return np.concatenate([batch, pad])
 
-    @staticmethod
-    def _encode_sync(codec, cells: np.ndarray):
+    def _encode_sync(self, codec, cells: np.ndarray):
         """(B, k, su) u8 -> (parity (B, m, su) u8, crcs | None)."""
         engine = getattr(codec, "resolved_backend", lambda: "device")()
         b, k, su = cells.shape
@@ -428,6 +486,9 @@ class ECBatcher:
             parity = np.ascontiguousarray(
                 par.reshape(codec.m, b, su).transpose(1, 0, 2))
             return parity, None
+        mesh = self.mesh()
+        if mesh is not None and hasattr(codec, "encode_crc_batch_mesh"):
+            return self._mesh_encode_sync(codec, cells, mesh)
         from ..ops import rs
 
         batch = ECBatcher._pow2_pad(rs.pack_u32(cells))
@@ -435,8 +496,27 @@ class ECBatcher:
         return (rs.unpack_u32(np.asarray(parity_w)[:b]),
                 np.asarray(crcs)[:b])
 
-    @staticmethod
-    def _decode_sync(codec, present: tuple, want: tuple,
+    def _mesh_encode_sync(self, codec, cells: np.ndarray, mesh):
+        """Device-resident shard staging: ONE pad (pow2 + stripe-
+        divisible), one sharded device_put so batched stripes land on
+        their owning chips, one fused encode+CRC dispatch jitted under
+        the mesh — each of the k+m shard rows' cells and CRCs are
+        produced where they live, and the results come back as
+        per-device shard views with NO whole-array host gather."""
+        from ..ops import rs
+        from ..parallel import runtime
+
+        b, k, su = cells.shape
+        batch = ECBatcher._pow2_pad(rs.pack_u32(cells), mesh)
+        parity_w, crcs_d = codec.encode_crc_batch_mesh(batch, su, mesh)
+        parity = runtime.shard_rows_to_host(parity_w)
+        crcs = runtime.shard_rows_to_host(crcs_d)
+        runtime.STATS.bump(encode_stripes=b)
+        if self.perf is not None:
+            self.perf.inc("ec_mesh_encode_dispatches")
+        return rs.unpack_u32(parity[:b]), crcs[:b]
+
+    def _decode_sync(self, codec, present: tuple, want: tuple,
                      cells: np.ndarray) -> np.ndarray:
         """(B, k', su) u8 survivors -> (B, len(want), su) u8."""
         engine = getattr(codec, "resolved_backend", lambda: "device")()
@@ -448,8 +528,33 @@ class ECBatcher:
             out = native.rs_matmul(mat, flat, threads=os.cpu_count() or 1)
             return np.ascontiguousarray(
                 out.reshape(len(want), b, su).transpose(1, 0, 2))
+        mesh = self.mesh()
+        mode = self._repair_mode()
+        if (mesh is not None and mode != "off"
+                and hasattr(codec, "decode_batch_mesh")):
+            return self._mesh_decode_sync(codec, present, want, cells,
+                                          mesh, mode)
         from ..ops import rs
 
         batch = ECBatcher._pow2_pad(rs.pack_u32(cells))
         out = codec.decode_batch(present, batch, want=want)
         return rs.unpack_u32(np.asarray(out)[:b])
+
+    def _mesh_decode_sync(self, codec, present: tuple, want: tuple,
+                          cells: np.ndarray, mesh,
+                          method: str) -> np.ndarray:
+        """Collective repair: survivors staged one chunk-group per
+        width device, the stacked recovery matmul distributed across
+        the mesh with partials XOR-combined by ``method`` — the
+        messenger-fan-in-free decode side of the serving mesh."""
+        from ..ops import rs
+        from ..parallel import runtime
+
+        b, kp, su = cells.shape
+        batch = ECBatcher._pow2_pad(rs.pack_u32(cells), mesh)
+        out = codec.decode_batch_mesh(present, batch, want, mesh, method)
+        host = runtime.shard_rows_to_host(out)
+        runtime.STATS.bump(decode_stripes=b)
+        if self.perf is not None:
+            self.perf.inc("ec_mesh_decode_dispatches")
+        return rs.unpack_u32(host[:b])

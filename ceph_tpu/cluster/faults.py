@@ -15,7 +15,10 @@ through the three layers where real clusters break —
   Specs registered on the plane re-arm automatically on OSD revive.
 - **daemon lifecycle** (``Thrasher``): randomized kill/revive/flap and
   partition schedules orchestrated through ``vstart.TestCluster``
-  (plus mon failover when the cluster runs a Paxos quorum).
+  (plus mon failover when the cluster runs a Paxos quorum, and —
+  with ``chip_loss`` — mesh-chip losses: a dark device maps to EC
+  device-dispatch failure on exactly its owning OSDs, see
+  ``chip_owners``).
 
 Everything derives from ONE seed: the thrash schedule is generated
 upfront as a pure function of (seed, duration, topology) — same seed,
@@ -212,28 +215,42 @@ class FaultPlane:
 
     def attach_osd(self, osd) -> None:
         """Wire a (re)started OSD into the plane: registered store
-        fault specs arm on its injector, and injections feed its
-        faults_injected_* perf counters."""
+        fault specs arm on its injector (honoring any OSD scope, so a
+        revived OSD whose chip is still dark comes back dark), and
+        injections feed its faults_injected_* perf counters."""
         self._injectors.append((osd.id, osd.fault))
-        for site, spec in self._store_specs.items():
-            osd.fault.arm(site, rng=self._store_rng, **spec)
+        for site, (spec, ids) in self._store_specs.items():
+            if ids is None or osd.id in ids:
+                osd.fault.arm(site, rng=self._store_rng, **spec)
 
     def store_fault(self, site: str, count: int = -1, p: float = 1.0,
-                    delay: float = 0.0, **match) -> None:
+                    delay: float = 0.0, osd_ids=None, **match) -> None:
         """Arm a store/device fault site on every attached OSD (and
-        every OSD revived later). Probability draws come from the
+        every OSD revived later) — or, with ``osd_ids``, only on that
+        subset (the chip-loss arm: a dark mesh device maps to faults
+        on exactly its owning OSDs). Probability draws come from the
         plane's seeded store RNG. Re-arming a site REPLACES the prior
         spec on live injectors — stacking arms would make live and
         revived OSDs fire at different rates."""
         spec = dict(count=count, p=p, delay=delay, **match)
-        self._store_specs[site] = spec
+        ids = None if osd_ids is None else frozenset(osd_ids)
+        self._store_specs[site] = (spec, ids)
         seen: set[int] = set()
         for osd_id, inj in reversed(self._injectors):
             if osd_id in seen:
                 continue  # only the newest incarnation is live
             seen.add(osd_id)
             inj.disarm(site)
-            inj.arm(site, rng=self._store_rng, **spec)
+            if ids is None or osd_id in ids:
+                inj.arm(site, rng=self._store_rng, **spec)
+
+    def clear_store_fault(self, site: str) -> None:
+        """Disarm ONE site everywhere (the chip-heal verb: the other
+        armed layers — bitrot, delays — keep thrashing)."""
+        if self._store_specs.pop(site, None) is None:
+            return
+        for _osd_id, inj in self._injectors:
+            inj.disarm(site)
 
     def clear_store_faults(self) -> None:
         sites = list(self._store_specs)
@@ -261,18 +278,33 @@ class FaultPlane:
 class ThrashEvent:
     t: float      # seconds from thrash start
     kind: str     # kill | revive | partition | heal | mon_flap
-    target: int = -1  # osd id (kill/revive/partition); -1 = n/a
+    #             # | chip_loss | chip_heal
+    target: int = -1  # osd id (kill/revive/partition) or mesh chip
+    #                   (chip_loss/chip_heal); -1 = n/a
+
+
+def chip_owners(n_osds: int, n_chips: int, chip: int) -> list[int]:
+    """The OSDs whose EC staging is pinned to mesh device ``chip``:
+    the serving path binds osd i to chip i % n_chips (the static
+    shard-to-device binding of parallel/runtime.py's process-shared
+    mesh) — so one chip going dark maps to device-dispatch failure on
+    exactly these daemons."""
+    return [i for i in range(n_osds) if i % n_chips == chip]
 
 
 def build_schedule(seed: int, duration: float, n_osds: int,
                    max_unavail: int = 1, gap: tuple[float, float] =
                    (0.4, 1.2), partitions: bool = True,
-                   mon_flaps: bool = False) -> list[ThrashEvent]:
+                   mon_flaps: bool = False, chip_loss: bool = False,
+                   n_chips: int = 8) -> list[ThrashEvent]:
     """Deterministic thrash schedule: a pure function of its arguments
     (same seed => same schedule, the replayability contract). The
-    generator tracks the dead/partitioned set so it never schedules
-    more than ``max_unavail`` simultaneously-unavailable OSDs — an EC
-    pool keeps >= k shards reachable throughout."""
+    generator tracks the dead/partitioned/dark set so it never
+    schedules more than ``max_unavail`` simultaneously-unavailable
+    OSDs — an EC pool keeps >= k shards reachable throughout. With
+    ``chip_loss``, mesh-chip failures join the mix: a dark chip
+    counts every live owning OSD (chip_owners) against the
+    availability budget, exactly like a kill of those daemons."""
     rng = random.Random(seed)
     # an all-dead cluster has nothing left to thrash (and nothing to
     # converge back): always keep at least one OSD reachable
@@ -280,21 +312,29 @@ def build_schedule(seed: int, duration: float, n_osds: int,
     events: list[ThrashEvent] = []
     dead: set[int] = set()
     cut: int = -1  # osd currently partitioned, -1 = none
+    dark: int = -1  # mesh chip currently lost, -1 = none
+    dark_owners: set[int] = set()
     t = 0.0
     while True:
         t += rng.uniform(*gap)
         if t >= duration:
             break
         choices: list[str] = []
-        unavail = len(dead) + (1 if cut >= 0 else 0)
+        unavail = (len(dead) + (1 if cut >= 0 else 0)
+                   + len(dark_owners - dead - ({cut} if cut >= 0
+                                               else set())))
         if unavail < max_unavail:
             choices.append("kill")
             if partitions and cut < 0:
                 choices.append("partition")
+        if chip_loss and dark < 0:
+            choices.append("chip_loss")
         if dead:
             choices += ["revive"] * 2  # bias toward healing
         if cut >= 0:
             choices += ["heal"] * 2
+        if dark >= 0:
+            choices += ["chip_heal"] * 2
         if mon_flaps:
             choices.append("mon_flap")
         if not choices:
@@ -315,6 +355,25 @@ def build_schedule(seed: int, duration: float, n_osds: int,
         elif kind == "heal":
             events.append(ThrashEvent(round(t, 3), "heal", cut))
             cut = -1
+        elif kind == "chip_loss":
+            # only chips whose owners fit in the remaining budget (a
+            # dark chip's owners are unavailable for EC device work)
+            eligible = [
+                ch for ch in range(n_chips)
+                if (owners := set(chip_owners(n_osds, n_chips, ch)))
+                and unavail + len(owners - dead
+                                  - ({cut} if cut >= 0 else set()))
+                <= max_unavail
+            ]
+            if eligible:
+                dark = rng.choice(eligible)
+                dark_owners = set(chip_owners(n_osds, n_chips, dark))
+                events.append(ThrashEvent(round(t, 3), "chip_loss",
+                                          dark))
+        elif kind == "chip_heal":
+            events.append(ThrashEvent(round(t, 3), "chip_heal", dark))
+            dark = -1
+            dark_owners = set()
         elif kind == "mon_flap":
             events.append(ThrashEvent(round(t, 3), "mon_flap"))
     return events
@@ -473,7 +532,8 @@ class Thrasher:
                  bitrot_p: float = 0.0, partitions: bool = True,
                  mon_flaps: bool = False, n_objects: int = 8,
                  obj_size: int = 24 << 10, writers: int = 4,
-                 settle_timeout: float = 90.0):
+                 settle_timeout: float = 90.0,
+                 chip_loss: bool = False, n_chips: int = 8):
         self.cluster = cluster
         self.plane: FaultPlane = cluster.faults
         self.pool_id = pool_id
@@ -483,13 +543,16 @@ class Thrasher:
         self.bitrot_p = bitrot_p
         self.partitions = partitions
         self.mon_flaps = mon_flaps and len(cluster.mons) > 1
+        self.chip_loss = chip_loss
+        self.n_chips = n_chips
         self.settle_timeout = settle_timeout
         self.workload = OracleWorkload(cluster.client, pool_id,
                                        seed=seed, n_objects=n_objects,
                                        size=obj_size, writers=writers)
         self.schedule = build_schedule(
             seed, duration, cluster.n_osds, max_unavail=max_unavail,
-            partitions=partitions, mon_flaps=self.mon_flaps)
+            partitions=partitions, mon_flaps=self.mon_flaps,
+            chip_loss=chip_loss, n_chips=n_chips)
         self.applied: list[ThrashEvent] = []
         self._dead_mons: list[int] = []
 
@@ -505,6 +568,16 @@ class Thrasher:
             self.plane.net.partition({f"osd.{ev.target}"}, {"*"})
         elif ev.kind == "heal":
             self.plane.net.heal()
+        elif ev.kind == "chip_loss":
+            # a mesh device going dark: every EC device dispatch on
+            # the owning OSDs fails (EIO-shaped ec_batch failure) —
+            # writes bounce and retry elsewhere in time, degraded
+            # reads route around the dark daemons, and repair after
+            # chip_heal runs the collective path
+            owners = chip_owners(c.n_osds, self.n_chips, ev.target)
+            self.plane.store_fault("ec_batch", p=1.0, osd_ids=owners)
+        elif ev.kind == "chip_heal":
+            self.plane.clear_store_fault("ec_batch")
         elif ev.kind == "mon_flap":
             # never break the quorum MAJORITY: killed mons stay down
             # until the final heal, and a second flap on a 3-mon

@@ -1508,7 +1508,14 @@ class PG:
             for name, val in st8.sys_attrs.items():
                 t.setattr(cid, oid, name, val)
             shard_txns[pos] = t
-            hpatches[pos] = patch.tobytes()
+            # a view over the (T, 2) patch table, not a tobytes copy:
+            # the wire codec flattens at ITS boundary, local fan-out
+            # consumes it via np.frombuffer either way (buffer plane).
+            # T=0 (xattr-only mutation) stays b"" — memoryview.cast
+            # rejects zero-sized shapes, and "no patch" is the wire
+            # contract for untouched data anyway
+            hpatches[pos] = (memoryview(patch).toreadonly().cast("B")
+                             if patch.size else b"")
         await self._ec_fanout(oid, entries, shard_txns, hpatch=hpatches,
                               ncells=new_nst, size=new_size, live=live,
                               extras=self._dual_write_extras(oid, st8))
@@ -3279,21 +3286,23 @@ class PG:
         g = codec._position_to_generator(shard)
         rebuilt = await self._decode_cells_batched(
             codec, si, chunks, maxlen, want_generators=(g,))
-        chunk = rebuilt[:, 0, :].reshape(-1)[:maxlen].tobytes()
+        # the rebuilt chunk stays an array view end-to-end: the hinfo
+        # CRC pass reads it in place, and both consumers — the push
+        # message body and the store transaction — take views, so the
+        # old whole-chunk .tobytes() copy is gone (buffer plane)
+        chunk_arr = np.ascontiguousarray(
+            rebuilt[:, 0, :]).reshape(-1)[:maxlen]
         out_attrs = {
             **user_attrs,
             ATTR_SIZE: size_attr,
             ATTR_HINFO: st.enc_hinfo(
-                st.StripeInfo.cell_crcs(
-                    np.frombuffer(chunk, np.uint8), si.su
-                )
-            ),
+                st.StripeInfo.cell_crcs(chunk_arr, si.su)),
         }
         if vbest != ZERO:
             # the generation this rebuild represents; callers that know
             # a newer authoritative version override it
             out_attrs[ATTR_V] = enc_ver(vbest)
-        return chunk, out_attrs
+        return memoryview(chunk_arr).toreadonly(), out_attrs
 
     # ---------------------------------------------- peering-side handlers
 

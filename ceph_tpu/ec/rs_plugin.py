@@ -197,6 +197,32 @@ class RSCodec(ErasureCode):
 
         return rs.jit_encode_with_crcs(self.matrix, cell_bytes)(data)
 
+    def encode_crc_batch_mesh(self, data, cell_bytes: int, mesh):
+        """encode_crc_batch jitted UNDER a (stripe, width) device
+        mesh: the (B, k, W) uint32 batch is staged device-resident
+        (chunk_batch_sharding), the fused encode+CRC program runs
+        sharded so each chip produces the shard rows and CRCs it owns,
+        and both results come back as MESH-SHARDED jax arrays for
+        per-device consumption (parallel/runtime.py) — the serving-
+        path form of the dryrun-only MULTICHIP shape."""
+        from ..parallel import runtime
+
+        return runtime.mesh_encode_crc_batch(mesh, self.matrix,
+                                             cell_bytes, data)
+
+    def decode_batch_mesh(self, present: tuple[int, ...], surviving,
+                          want: tuple[int, ...], mesh, method: str):
+        """Collective repair: the stacked recovery matmul for ``want``
+        rows from ``present`` survivors, distributed over the mesh —
+        survivors resident one chunk-group per width device, partials
+        XOR-combined by ``method`` (allgather / psum_bits) instead of
+        gathered through messenger fan-in. Returns the (B, R, W)
+        result batch-sharded."""
+        from ..parallel import runtime
+
+        rmat = self.decode_matrix_for(present, want)
+        return runtime.mesh_decode_cells(mesh, rmat, surviving, method)
+
     def decode_batch(self, present: tuple[int, ...], surviving,
                      want: tuple[int, ...] | None = None):
         """(B, k, W) uint32 survivors (rows in `present` order) ->

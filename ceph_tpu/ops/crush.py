@@ -36,13 +36,23 @@ _U32 = jnp.uint32
 _I64 = jnp.int64
 INT64_MIN = -(1 << 63)
 
+# jax.enable_x64 (the top-level alias) was removed upstream; the
+# experimental home has carried the context manager across every jax
+# this repo supports, so resolve it once here and let the rest of the
+# tree import THIS symbol (ops.crush.enable_x64) instead of racing
+# jax's deprecation shims.
+try:
+    enable_x64 = jax.enable_x64
+except AttributeError:  # newer jax: experimental home only
+    from jax.experimental import enable_x64
+
 
 def _x64(fn):
     """Run fn under scoped 64-bit mode (int64 constants trace correctly)."""
 
     @functools.wraps(fn)
     def wrapper(*args, **kwargs):
-        with jax.enable_x64():
+        with enable_x64():
             return fn(*args, **kwargs)
 
     return wrapper
@@ -292,7 +302,7 @@ def straw2_bulk(
     )
     weights_d = jnp.asarray(np.ascontiguousarray(weights, dtype=np.uint32))
     xs_d = jnp.asarray(np.ascontiguousarray(xs, dtype=np.uint32))
-    with jax.enable_x64():
+    with enable_x64():
         out = _jit_straw2(
             items_d, ids_d, weights_d, xs_d, jnp.asarray(r, dtype=jnp.uint32)
         )

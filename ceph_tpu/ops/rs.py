@@ -331,8 +331,16 @@ def _partitioned_gf_pallas(rows: int):
 
         return mesh, lower_fn, x_sh, (x_sh, bm_sh)
 
-    fn.def_partition(infer_sharding_from_operands=infer, partition=partition,
-                     sharding_rule="b c w, rr cc -> b r w")
+    try:
+        fn.def_partition(infer_sharding_from_operands=infer,
+                         partition=partition,
+                         sharding_rule="b c w, rr cc -> b r w")
+    except TypeError:
+        # older jax: def_partition has no sharding_rule (the einsum-
+        # notation hint for shardy); the callback pair alone carries
+        # the GSPMD lowering there
+        fn.def_partition(infer_sharding_from_operands=infer,
+                         partition=partition)
     _PARTITIONED_GF_PALLAS[rows] = fn
     return fn
 

@@ -148,3 +148,21 @@ def pad_batch(n: int, mesh: Mesh) -> int:
     """Smallest batch >= n divisible by the stripe-axis size."""
     s = mesh.shape[STRIPE_AXIS]
     return math.ceil(n / s) * s
+
+
+def pad_batch_pow2(n: int, mesh: Mesh | None = None) -> int:
+    """ONE pad decision for the batched data path: the smallest batch
+    >= n that satisfies BOTH the jit shape-bucketing cap
+    (ECBatcher._pow2_pad's reason to exist: log-many compiled shapes)
+    and, when a mesh is given, divisibility by the stripe-axis size.
+    Computing the two pads in sequence double-pads (n=5, stripe=6:
+    pow2 pads 5->8, then the mesh pad 8->12, where 6 was already
+    enough). Folded form: stripe_size * next_pow2(ceil(n / stripe)) —
+    every PER-DEVICE batch length is a power of two, shape count stays
+    O(log B), and the mesh pad is minimal. Without a mesh this is the
+    plain next power of two."""
+    if mesh is None:
+        return 1 << max(0, (n - 1)).bit_length()
+    s = mesh.shape[STRIPE_AXIS]
+    per_dev = math.ceil(n / s)
+    return s * (1 << max(0, (per_dev - 1)).bit_length())

@@ -126,6 +126,42 @@ def _distributed_matmul(mesh: Mesh, matrix: np.ndarray,
         mesh, m.tobytes(), m.shape[0], m.shape[1], method)(chunks)
 
 
+def pad_chunk_axis(matrix: np.ndarray,
+                   chunks: np.ndarray,
+                   n_dev: int) -> tuple[np.ndarray, np.ndarray]:
+    """Zero-pad the survivor/chunk axis so it splits evenly over the
+    width devices: zero matrix COLUMNS multiply zero chunk ROWS, and a
+    GF zero column contributes nothing to any output — the padded
+    product is bit-identical to the unpadded one. This is what lets
+    collective repair serve any k' (e.g. k'=3 survivors over width=2,
+    or a k+m that is not a multiple of the mesh width)."""
+    c = matrix.shape[1]
+    pad = (-c) % n_dev
+    if not pad:
+        return matrix, chunks
+    m = np.concatenate(
+        [matrix, np.zeros((matrix.shape[0], pad), dtype=np.uint8)],
+        axis=1)
+    z = np.zeros(chunks.shape[:-2] + (pad, chunks.shape[-1]),
+                 dtype=chunks.dtype)
+    return m, np.concatenate([chunks, z], axis=-2)
+
+
+def distributed_matmul(mesh: Mesh, matrix: np.ndarray, chunks,
+                       method: str = "allgather"):
+    """Public serving-path entry: (B, C, W) uint32 chunks — a jax
+    array already resident shard_placement_sharding(mesh), or a host
+    array to be staged that way — times an (R, C) GF matrix, partials
+    combined across the width axis by ``method``. Returns (B, R, W)
+    batch-sharded, whole on every width-group device. The chunk axis
+    must already divide the mesh width (pad_chunk_axis)."""
+    if not isinstance(chunks, jax.Array):
+        chunks = jax.device_put(
+            np.ascontiguousarray(chunks),
+            shard_placement_sharding(mesh))
+    return _distributed_matmul(mesh, matrix, chunks, method)
+
+
 def distributed_repair(mesh: Mesh, matrix: np.ndarray, k: int,
                        present: list[int], chunks: jax.Array,
                        method: str = "allgather") -> jax.Array:

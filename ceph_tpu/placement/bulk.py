@@ -472,7 +472,7 @@ def do_rule_bulk(
     fn = _jit_engine(rule.op)
     outs = []
     static = (rule, r_eff, compiled.max_depth, root_bno)
-    with jax.enable_x64():
+    with crush_ops.enable_x64():
         args = (
             jnp.asarray(compiled.items),
             jnp.asarray(compiled.weights),

@@ -210,6 +210,25 @@ SCHEMA = Schema([
                 "mClock queue; >1 lets EC stripes from different ops "
                 "coalesce into one device batch (per-PG write ordering "
                 "is preserved by the PG lock)"),
+    Option("osd_ec_mesh_devices", "int", 0, min=0,
+           desc="device count of the EC serving-path mesh: >1 pins the "
+                "ECBatcher's staging to a (stripe, width) jax mesh so "
+                "batched stripes land sharded and the fused encode+CRC "
+                "runs on the chip that owns each shard row (0/1 = the "
+                "single-device path; degrades gracefully when the "
+                "platform cannot supply the devices)"),
+    Option("osd_ec_mesh_width", "int", 1, min=1,
+           desc="width-axis size of the serving mesh (must divide "
+                "osd_ec_mesh_devices): chunk words stripe across width "
+                "devices, the remainder goes to the stripe/batch axis"),
+    Option("parallel_repair_mode", "str", "off",
+           enum=("off", "allgather", "psum_bits"),
+           desc="EC repair/degraded-decode combine strategy on the "
+                "mesh: off = single-device stacked-matrix decode; "
+                "allgather / psum_bits = shard_comm's distributed GF "
+                "matmul with recovery partials combined by mesh "
+                "collectives instead of messenger fan-in (needs "
+                "osd_ec_mesh_devices > 1)"),
     Option("osd_ec_verify_on_read", "bool", True,
            desc="verify per-cell hinfo CRC32C on EVERY EC read, normal "
                 "or degraded: a mismatch excludes the shard (EIO, "

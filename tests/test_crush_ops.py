@@ -86,10 +86,10 @@ def test_lut_nogather_bit_exact():
     u = jnp.asarray(np.arange(65536, dtype=np.uint32))
     try:
         crush_ops.LUT_USE_GATHER = False
-        with jax.enable_x64():
+        with crush_ops.enable_x64():
             nogather = np.asarray(jax.jit(crush_ops.crush_ln)(u))
         crush_ops.LUT_USE_GATHER = True
-        with jax.enable_x64():
+        with crush_ops.enable_x64():
             gather = np.asarray(jax.jit(crush_ops.crush_ln)(u))
     finally:
         crush_ops.LUT_USE_GATHER = None
@@ -129,7 +129,7 @@ def test_div_u48_exact_corner_lattice():
     import jax
     import jax.numpy as jnp
 
-    with jax.enable_x64():
+    with crush_ops.enable_x64():
         got = np.asarray(jax.jit(crush_ops._div_u48)(
             jnp.asarray(n_arr), jnp.asarray(w_arr)))
     want = n_arr // w_arr

@@ -880,6 +880,108 @@ def test_revived_peon_rediscovers_leader_without_election():
     run(t())
 
 
+def test_chip_loss_schedule_deterministic_and_bounded():
+    """chip_loss events join the schedule deterministically, one dark
+    chip at a time, with the dark chip's OWNING OSDs counted against
+    the availability budget like kills."""
+    from ceph_tpu.cluster.faults import chip_owners
+
+    kw = dict(max_unavail=2, chip_loss=True, n_chips=4)
+    s1 = build_schedule(77, 120.0, 5, **kw)
+    assert s1 == build_schedule(77, 120.0, 5, **kw)
+    kinds = {e.kind for e in s1}
+    assert "chip_loss" in kinds and "chip_heal" in kinds
+    # without the flag the schedule is exactly the legacy one (no
+    # extra rng draws: replayability across the flag)
+    legacy = build_schedule(77, 120.0, 5, max_unavail=2)
+    assert all(e.kind not in ("chip_loss", "chip_heal")
+               for e in legacy)
+    # replay: unavailability (dead + cut + dark-chip owners) bounded
+    dead, cut, dark = set(), set(), set()
+    for ev in s1:
+        if ev.kind == "kill":
+            dead.add(ev.target)
+        elif ev.kind == "revive":
+            dead.discard(ev.target)
+        elif ev.kind == "partition":
+            cut = {ev.target}
+        elif ev.kind == "heal":
+            cut = set()
+        elif ev.kind == "chip_loss":
+            assert not dark
+            dark = set(chip_owners(5, 4, ev.target))
+            assert dark  # only owner-ful chips get scheduled
+        elif ev.kind == "chip_heal":
+            dark = set()
+        assert len(dead | (cut - dead) | (dark - dead - cut)) <= 2
+
+
+def test_chip_loss_fault_scopes_to_owning_osds():
+    """The chip-loss arm fires EC device dispatches only on the dark
+    chip's owners, re-arms on revive (a revived OSD whose chip is
+    still dark comes back dark), and chip_heal disarms everywhere
+    without touching other armed sites."""
+    async def t():
+        c = await make_ec_cluster(seed=17)
+        c.faults.store_fault("ec_read_bitflip", p=0.01)  # another arm
+        # chip 1 of 4 owns osd.1 (1 % 4) — and nobody else at n=5
+        c.faults.store_fault("ec_batch", p=1.0, osd_ids=[1])
+        assert c.osds[1].fault._arms.get("ec_batch")
+        assert not c.osds[0].fault._arms.get("ec_batch")
+        assert not c.osds[4].fault._arms.get("ec_batch")
+        await c.kill_osd(1)
+        await c.revive_osd(1)
+        assert c.osds[1].fault._arms.get("ec_batch")
+        c.faults.clear_store_fault("ec_batch")
+        assert not c.osds[1].fault._arms.get("ec_batch")
+        # the unrelated site survives the single-site heal
+        assert c.osds[2].fault._arms.get("ec_read_bitflip")
+        await c.stop()
+
+    run(t())
+
+
+def test_short_chip_loss_thrash_converges_over_mesh():
+    """Tier-1 chip-loss thrash: the serving mesh on (device engine,
+    collective repair), a seeded ~4 s schedule that includes mesh-chip
+    losses, byte-exact convergence — the small sibling of the 20 s
+    CLI acceptance run (tools/thrash.py --chip-loss)."""
+    from ceph_tpu.parallel import runtime
+
+    async def t():
+        c = TestCluster(n_osds=5, fault_seed=4242, osd_conf={
+            "osd_ec_mesh_devices": 8,
+            "osd_ec_mesh_width": 2,
+            "parallel_repair_mode": "allgather",
+        })
+        await c.start()
+        await c.client.create_pool(
+            Pool(id=2, name="ec", size=5, min_size=3, pg_num=8,
+                 crush_rule=1, type="erasure",
+                 ec_profile=dict(EC_PROFILE)))
+        await c.wait_active(20)
+        c.client.op_timeout = 150.0
+        runtime.STATS.reset()
+        thr = Thrasher(c, 2, seed=4242, duration=4.0, max_unavail=2,
+                       bitrot_p=0.0, partitions=False, n_objects=6,
+                       obj_size=16 << 10, writers=3,
+                       settle_timeout=90.0, chip_loss=True, n_chips=8)
+        assert thr.schedule == build_schedule(
+            4242, 4.0, 5, max_unavail=2, partitions=False,
+            chip_loss=True, n_chips=8)
+        assert any(e.kind == "chip_loss" for e in thr.schedule)
+        verdict = await thr.run()
+        assert verdict["passed"], verdict
+        assert verdict["writes_acked"] > 0
+        assert any(k == "chip_loss" for _, k, _ in verdict["events"])
+        await c.stop()
+
+    run(t(), timeout=300)
+    # the thrash actually rode the mesh
+    assert runtime.STATS.dump()["mesh_encode_dispatches"] > 0
+    assert runtime.STATS.dump()["mesh_host_gathers"] == 0
+
+
 def test_plane_store_fault_rearms_on_revive():
     """A plane-registered store fault survives kill/revive: the spec
     re-arms on the fresh injector (specs outlive incarnations)."""

@@ -1,0 +1,138 @@
+"""parallel/ sharding helpers: mesh factoring, placement specs, the
+unified pad — the direct coverage test_shard_comm only gave these
+transitively. Runs on the 8-device virtual CPU platform conftest pins.
+"""
+import jax
+import numpy as np
+import pytest
+
+from ceph_tpu import parallel
+
+K, SU_WORDS = 3, 16
+
+
+@pytest.fixture(scope="module")
+def devs():
+    return parallel.get_devices(8)
+
+
+# ------------------------------------------------------------ make_mesh
+
+
+def test_make_mesh_width_factoring(devs):
+    m1 = parallel.make_mesh(devs, width=1)
+    assert dict(m1.shape) == {"stripe": 8, "width": 1}
+    m4 = parallel.make_mesh(devs, width=4)
+    assert dict(m4.shape) == {"stripe": 2, "width": 4}
+    m8 = parallel.make_mesh(devs, width=8)
+    assert dict(m8.shape) == {"stripe": 1, "width": 8}
+    # a mesh over a device subset factors that subset
+    m6 = parallel.make_mesh(devs[:6], width=3)
+    assert dict(m6.shape) == {"stripe": 2, "width": 3}
+
+
+def test_make_mesh_rejects_nondividing_width(devs):
+    with pytest.raises(ValueError, match="does not divide"):
+        parallel.make_mesh(devs, width=3)
+
+
+# ----------------------------------------------------- placement specs
+
+
+def _shard_shapes(arr):
+    """{device id -> local shard shape} with replica dedup by index."""
+    seen = {}
+    for s in arr.addressable_shards:
+        seen.setdefault(tuple((sl.start, sl.stop) for sl in s.index),
+                        np.asarray(s.data).shape)
+    return list(seen.values())
+
+
+def test_chunk_batch_vs_per_stripe_vs_replicated_placement(devs):
+    mesh = parallel.make_mesh(devs, width=4)  # stripe 2, width 4
+    batch = np.arange(8 * K * SU_WORDS, dtype=np.uint32).reshape(
+        8, K, SU_WORDS)
+
+    cb = jax.device_put(batch, parallel.chunk_batch_sharding(mesh))
+    # batch split over stripe (8/2), words over width (16/4), the
+    # chunk axis REPLICATED — the "EC shard axis stays local" layout
+    assert _shard_shapes(cb) == [(4, K, 4)] * 8
+    spec = cb.sharding.spec
+    assert spec[0] == parallel.STRIPE_AXIS and spec[2] == \
+        parallel.WIDTH_AXIS
+
+    ps = jax.device_put(np.arange(8, dtype=np.uint32),
+                        parallel.per_stripe_sharding(mesh))
+    # per-stripe scalars: one batch block per stripe row, width
+    # replicates (2 unique blocks across the 8 devices)
+    assert sorted(s[0] for s in _shard_shapes(ps)) == [4, 4]
+
+    rp = jax.device_put(np.arange(8, dtype=np.uint32),
+                        parallel.replicated(mesh))
+    # fully replicated: ONE unique (whole) block
+    assert _shard_shapes(rp) == [(8,)]
+
+    # round-trips preserve content
+    assert (np.asarray(cb) == batch).all()
+    assert (np.asarray(ps) == np.arange(8, dtype=np.uint32)).all()
+
+
+def test_shard_placement_puts_chunks_on_width_devices(devs):
+    from ceph_tpu.parallel import shard_comm
+
+    mesh = parallel.make_mesh(devs, width=4)
+    batch = np.zeros((4, 8, SU_WORDS), dtype=np.uint32)
+    xs = jax.device_put(batch, shard_comm.shard_placement_sharding(mesh))
+    # chunk axis over width: 8 chunks / 4 width devices = 2 resident
+    # chunk rows per device, batch over stripe
+    assert _shard_shapes(xs) == [(2, 2, SU_WORDS)] * 8
+
+
+# ------------------------------------------------------------- padding
+
+
+def test_pad_batch_pow2_is_single_pad(devs):
+    # no mesh: plain next power of two
+    assert [parallel.pad_batch_pow2(n) for n in (1, 2, 3, 5, 8, 9)] \
+        == [1, 2, 4, 8, 8, 16]
+    m6 = parallel.make_mesh(devs[:6], width=1)  # stripe axis 6
+    # the old sequential shape double-padded: pow2(5)=8, then mesh
+    # pad 8 -> 12; the folded pad lands on 6 (>=5, divisible by 6,
+    # pow2 per-device share)
+    assert parallel.pad_batch_pow2(5, m6) == 6
+    assert parallel.pad_batch_pow2(7, m6) == 12
+    assert parallel.pad_batch_pow2(13, m6) == 24
+    m8 = parallel.make_mesh(devs, width=2)  # stripe axis 4
+    # batch < devices: one stripe still pads to a full stripe row
+    assert parallel.pad_batch_pow2(1, m8) == 4
+    assert parallel.pad_batch_pow2(5, m8) == 8
+    # every result divides the stripe axis and covers n
+    for n in range(1, 40):
+        for mesh in (m6, m8):
+            p = parallel.pad_batch_pow2(n, mesh)
+            assert p >= n and p % mesh.shape["stripe"] == 0
+            # per-device share is a power of two (shape-bucketing cap)
+            share = p // mesh.shape["stripe"]
+            assert share & (share - 1) == 0
+
+
+def test_pow2_pad_uses_mesh_aware_target(devs):
+    from ceph_tpu.cluster.ecbatch import ECBatcher
+
+    m6 = parallel.make_mesh(devs[:6], width=1)
+    batch = np.zeros((5, K, SU_WORDS), dtype=np.uint32)
+    assert len(ECBatcher._pow2_pad(batch)) == 8
+    assert len(ECBatcher._pow2_pad(batch, m6)) == 6
+
+
+def test_pad_chunk_axis_zero_extends_matrix_and_chunks():
+    from ceph_tpu.parallel import shard_comm
+
+    mat = np.arange(6, dtype=np.uint8).reshape(2, 3)
+    chunks = np.ones((4, 3, SU_WORDS), dtype=np.uint32)
+    m2, c2 = shard_comm.pad_chunk_axis(mat, chunks, 2)
+    assert m2.shape == (2, 4) and (m2[:, 3] == 0).all()
+    assert c2.shape == (4, 4, SU_WORDS) and (c2[:, 3] == 0).all()
+    # already divisible: untouched objects pass through
+    m1, c1 = shard_comm.pad_chunk_axis(mat, chunks, 3)
+    assert m1 is mat and c1 is chunks

@@ -128,6 +128,14 @@ def test_blk_many_concurrent_writes(tmp_path):
 
 
 def test_blk_sparse_file_is_cheap(tmp_path):
+    # capability probe: some container filesystems (overlayfs and
+    # friends) materialize every truncated block, so "sparse is cheap"
+    # is an env property, not a code property — skip, don't fail
+    probe = tmp_path / "sparse-probe"
+    with open(probe, "wb") as f:
+        f.truncate(4 << 20)
+    if os.stat(probe).st_blocks * 512 >= 4 << 20:
+        pytest.skip("filesystem does not keep truncated files sparse")
     dev = rt.BlockDevice(tmp_path / "block", 1 << 32, n_threads=1)  # 4 GiB
     dev.pwrite(0, b"x")
     dev.close()

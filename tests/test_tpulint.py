@@ -716,6 +716,77 @@ def test_buffer_discipline_scoped_to_hot_paths():
     assert out == []
 
 
+# ------------------------------------------------------ mesh-discipline
+
+
+def test_mesh_discipline_device_get_fires():
+    out = lint(
+        """
+        import jax
+
+        def collect(parity):
+            return jax.device_get(parity)
+        """,
+        "ceph_tpu/parallel/fixture.py", only=["mesh-discipline"])
+    assert len(out) == 1
+    assert "jax.device_get" in out[0].message
+
+
+def test_mesh_discipline_whole_array_asarray_fires_in_batcher():
+    out = lint(
+        """
+        import numpy as np
+
+        class ECBatcher:
+            def _mesh_encode_sync(self, codec, cells, mesh):
+                parity, crcs = codec.encode_crc_batch_mesh(cells, 1, mesh)
+                return np.asarray(parity), np.asarray(crcs)
+        """,
+        "ceph_tpu/cluster/ecbatch.py", only=["mesh-discipline"])
+    assert len(out) == 2
+    assert all("per-device shard views" in m for m in msgs(out))
+
+
+def test_mesh_discipline_sanctioned_boundaries_clean():
+    # the per-device view reader, the counted gather, and the single-
+    # device engine boundary may materialize; device-list helpers too
+    out = lint(
+        """
+        import numpy as np
+
+        def shard_rows_to_host(arr, out=None):
+            for shard in arr.addressable_shards:
+                out[shard.index] = np.asarray(shard.data)
+            return out
+
+        def host_gather(arr):
+            return np.asarray(arr)
+
+        def make_mesh(devices, width):
+            return np.array(devices).reshape(-1, width)
+
+        class ECBatcher:
+            def _encode_sync(self, codec, cells):
+                return np.asarray(codec.encode_batch(cells))
+        """,
+        "ceph_tpu/parallel/fixture.py", only=["mesh-discipline"])
+    assert out == []
+
+
+def test_mesh_discipline_scoped_to_mesh_path():
+    # np.asarray outside parallel/ and the batcher is other rules'
+    # business (e.g. trace-safety's reactor-readback check)
+    out = lint(
+        """
+        import numpy as np
+
+        def collect(parity):
+            return np.asarray(parity)
+        """,
+        "ceph_tpu/cluster/pg.py", only=["mesh-discipline"])
+    assert out == []
+
+
 # ------------------------------------------------------------ repo gate
 
 

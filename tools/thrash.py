@@ -50,6 +50,18 @@ def main(argv: list[str] | None = None) -> int:
                          "(default: m)")
     ap.add_argument("--bitrot", type=float, default=0.01,
                     help="P(bit-flip) per shard read (default 1%%)")
+    ap.add_argument("--chip-loss", action="store_true",
+                    help="thrash the multi-chip data plane: run the "
+                         "pool on a forced host-device mesh (device "
+                         "engine, collective repair) and schedule "
+                         "mesh-chip losses — a dark chip fails EC "
+                         "device dispatches on its owning OSDs")
+    ap.add_argument("--chips", type=int, default=8,
+                    help="mesh device count for --chip-loss "
+                         "(default %(default)s)")
+    ap.add_argument("--mesh-width", type=int, default=2,
+                    help="mesh width axis for --chip-loss (must "
+                         "divide --chips; default %(default)s)")
     ap.add_argument("--no-partitions", action="store_true")
     ap.add_argument("--objects", type=int, default=8)
     ap.add_argument("--obj-size", type=int, default=24 << 10)
@@ -62,6 +74,9 @@ def main(argv: list[str] | None = None) -> int:
     args = ap.parse_args(argv)
     if args.k < 2 or args.m < 1 or args.osds < args.k + args.m:
         ap.error("need osds >= k + m, k >= 2, m >= 1")
+    if args.chip_loss and args.chips % args.mesh_width:
+        ap.error(f"--mesh-width {args.mesh_width} does not divide "
+                 f"--chips {args.chips}")
     max_unavail = args.max_unavail if args.max_unavail is not None \
         else args.m
 
@@ -71,11 +86,22 @@ def main(argv: list[str] | None = None) -> int:
         sched = build_schedule(args.seed, args.duration, args.osds,
                                max_unavail=max_unavail,
                                partitions=not args.no_partitions,
-                               mon_flaps=args.mons > 1)
+                               mon_flaps=args.mons > 1,
+                               chip_loss=args.chip_loss,
+                               n_chips=args.chips)
         print(json.dumps({"seed": args.seed,
                           "events": [[e.t, e.kind, e.target]
                                      for e in sched]}, indent=1))
         return 0
+
+    if args.chip_loss:
+        # the mesh must exist BEFORE any jax backend init: force the
+        # virtual host platform to the chip count (the CPU recipe the
+        # mesh tests use; on a real multi-chip host get_devices picks
+        # the healthy accelerator platform instead)
+        from ceph_tpu import parallel
+
+        parallel.pin_virtual_cpu(args.chips)
 
     verdict = asyncio.run(_run(args, max_unavail))
     print(json.dumps(verdict, indent=1, sort_keys=True))
@@ -87,8 +113,21 @@ async def _run(args, max_unavail: int) -> dict:
     from ceph_tpu.cluster.vstart import TestCluster
     from ceph_tpu.placement.osdmap import Pool
 
+    osd_conf = None
+    backend = "auto"
+    if args.chip_loss:
+        # the multi-chip serving path under thrash: device engine,
+        # mesh-sharded encode staging, collective repair — the arm
+        # that proves a chip loss degrades and repairs through the
+        # mesh, not just through messenger fan-in
+        osd_conf = {
+            "osd_ec_mesh_devices": args.chips,
+            "osd_ec_mesh_width": args.mesh_width,
+            "parallel_repair_mode": "allgather",
+        }
+        backend = "device"
     c = TestCluster(n_osds=args.osds, n_mons=args.mons,
-                    fault_seed=args.seed)
+                    fault_seed=args.seed, osd_conf=osd_conf)
     await c.start()
     # the oracle's ordering contract: one tid per op for the whole
     # thrash — the op must outlive any partition, so the deadline
@@ -98,17 +137,25 @@ async def _run(args, max_unavail: int) -> dict:
         id=2, name="thrash", size=args.k + args.m, min_size=args.k,
         pg_num=args.pg_num, crush_rule=1, type="erasure",
         ec_profile={"plugin": "rs_tpu", "k": str(args.k),
-                    "m": str(args.m), "backend": "auto"}))
+                    "m": str(args.m), "backend": backend}))
     await c.wait_active(30)
     thrasher = Thrasher(
         c, pool_id, seed=args.seed, duration=args.duration,
         max_unavail=max_unavail, bitrot_p=args.bitrot,
         partitions=not args.no_partitions, mon_flaps=args.mons > 1,
         n_objects=args.objects, obj_size=args.obj_size,
-        writers=args.writers, settle_timeout=args.settle)
+        writers=args.writers, settle_timeout=args.settle,
+        chip_loss=args.chip_loss, n_chips=args.chips)
     try:
         verdict = await thrasher.run()
         verdict["health"] = c.mon.health()
+        if args.chip_loss:
+            from ceph_tpu.parallel import runtime
+
+            # the mesh ledger proves the serving path actually ran
+            # sharded (encode dispatches > 0) and repaired through
+            # collectives (decode dispatches) with zero host gathers
+            verdict["mesh"] = runtime.STATS.dump()
     finally:
         await c.stop()
     return verdict
