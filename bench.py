@@ -1020,6 +1020,225 @@ def _multichip_child(n: int, width: int) -> int:
     return 0
 
 
+def config9_recovery_storm(_latency: float) -> dict:
+    """Recovery storm (ROADMAP "repair-economics codecs"): kill one
+    OSD under the config-6 write load and measure what production EC
+    actually lives on — DEGRADED performance — per codec family:
+    repair MiB/s (shard bytes rebuilt / time to clean), repair-traffic
+    amplification (survivor bytes fetched / bytes rebuilt: k for an
+    MDS code, d/q for Clay sub-chunk repair, the local group for
+    LRC), and degraded-read p50/p99 while the storm runs. Every
+    profile must prove its decodes rode the batched device pipeline
+    (ec_decode_batches > 0, ec_batch_isolated recorded) — the first
+    numbers this repo has for the path the paper's EC math exists for.
+
+    Runs in a SUBPROCESS like config 8 (the EC engine is forced to
+    "device" for every codec, which must not leak into the parent's
+    probe state) and keeps the same n_devices/rc/ok/skipped/tail
+    payload shape."""
+    import subprocess
+
+    cmd = [sys.executable, os.path.abspath(__file__),
+           "--recovery-storm-child"]
+    out = {"n_devices": 1, "rc": 0, "ok": False, "skipped": False,
+           "tail": ""}
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=1800)
+    except subprocess.TimeoutExpired as e:
+        out["rc"] = -1
+        out["tail"] = ((e.stderr or b"").decode("utf-8", "replace")
+                       if isinstance(e.stderr, bytes)
+                       else (e.stderr or ""))[-400:]
+        return out
+    out["rc"] = proc.returncode
+    err_lines = (proc.stderr or "").strip().splitlines()
+    out["tail"] = err_lines[-1][-400:] if err_lines else ""
+    if proc.returncode != 0:
+        out["tail"] = "\n".join(err_lines[-6:])[-800:]
+        return out
+    try:
+        detail = json.loads((proc.stdout or "").strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        out["tail"] = f"unparseable child stdout: {proc.stdout[-200:]!r}"
+        return out
+    profs = detail.get("profiles", {})
+    # the bar: >= 4 codec profiles measured, each with counter-proven
+    # batched decode dispatches (not a host per-stripe fallback) and a
+    # recorded repair amplification
+    out["ok"] = (len(profs) >= 4 and all(
+        p.get("ec_decode_batches", 0) > 0
+        and p.get("repair_amplification", 0) > 0
+        and p.get("oracle_ok") for p in profs.values()))
+    out.update(detail)
+    return out
+
+
+#: config 9 codec matrix: rs k8m3 is the config-6 baseline shape; the
+#: others are the repair-economics families (theoretical repair reads
+#: per rebuilt chunk: rs k=8, lrc local group 6, clay d/q = 11/4 =
+#: 2.75 with the default d=k+m-1, blaum_roth k=5)
+STORM_PROFILES = {
+    "rs_k8m3": {"plugin": "rs_tpu", "k": "8", "m": "3",
+                "backend": "device", "stripe_unit": "65536"},
+    "lrc_k8m4_l6": {"plugin": "lrc", "k": "8", "m": "4", "l": "6",
+                    "backend": "device", "stripe_unit": "65536"},
+    "clay_k8m4": {"plugin": "clay", "k": "8", "m": "4",
+                  "backend": "device", "stripe_unit": "65536"},
+    "blaum_roth_k5m2": {"plugin": "bitmatrix",
+                        "technique": "blaum_roth", "k": "5", "m": "2",
+                        "backend": "device", "stripe_unit": "65536"},
+}
+
+
+def _recovery_storm_child() -> int:
+    """Config 9's measured body (fresh process). One JSON line on
+    stdout: per-profile write MiB/s under storm, degraded-read
+    p50/p99, repair MiB/s + amplification, batching counters."""
+    os.environ["CEPH_TPU_EC_ENGINE"] = "device"
+
+    import asyncio
+
+    from ceph_tpu.ec import load_codec
+    from ceph_tpu.cluster.vstart import TestCluster
+    from ceph_tpu.placement.osdmap import Pool
+
+    obj_bytes = 4 << 20
+    concurrency = 16
+    write_secs = 4.0
+
+    async def storm(name: str, prof: dict) -> dict:
+        codec = load_codec(dict(prof))
+        size = codec.get_chunk_count()
+        c = TestCluster(n_osds=size + 2, out_interval=1.0, osd_conf={
+            "osd_ec_batch_window": 0.01,
+            "osd_ec_batch_target_stripes": 48,
+            "osd_op_concurrency": 32,
+        })
+        await c.start()
+        c.client.op_timeout = 120.0
+        c.client.conf.set("client_max_inflight", concurrency)
+        await c.client.create_pool(Pool(
+            id=2, name="storm", size=size, min_size=codec.k,
+            pg_num=16, crush_rule=1, type="erasure",
+            ec_profile=dict(prof)))
+        await c.wait_active(30)
+        payload = np.random.default_rng(5).integers(
+            0, 256, obj_bytes, dtype=np.uint8).tobytes()
+        await c.client.write_full(2, "warm", payload)  # compile
+        # ---- write load with a mid-phase kill (the storm trigger)
+        comps: list = []
+        seq = 0
+        t_end = time.perf_counter() + write_secs
+        t0 = time.perf_counter()
+        killed = None
+        t_kill = None
+        while time.perf_counter() < t_end:
+            if killed is None and time.perf_counter() - t0 > 1.0:
+                pgid = c.client.osdmap.object_to_pg(2, b"warm")
+                up, primary = c.mon.osdmap.pg_to_up_acting_osds(pgid)
+                killed = next(o for o in up if o != primary)
+                t_kill = time.perf_counter()
+                await c.kill_osd(killed)
+            comps.append((f"b-{seq}",
+                          await c.client.aio_write_full(
+                              2, f"b-{seq}", payload)))
+            seq += 1
+        if killed is None:
+            # the write phase outran the clock before the mid-phase
+            # trigger (slow first-shape compiles): kill now, while the
+            # window is still draining — the storm must always fire
+            pgid = c.client.osdmap.object_to_pg(2, b"warm")
+            up, primary = c.mon.osdmap.pg_to_up_acting_osds(pgid)
+            killed = next(o for o in up if o != primary)
+            t_kill = time.perf_counter()
+            await c.kill_osd(killed)
+        await c.client.writes_wait()
+        dt_w = time.perf_counter() - t0
+        written = []
+        for nm, comp in comps:
+            comp.result()
+            written.append(nm)
+        # ---- degraded reads while the storm recovers: per-op
+        # latencies for p50/p99 (the dead member's shards decode)
+        lat: list = []
+        oracle_ok = True
+        t0 = time.perf_counter()
+        for nm in written:
+            t1 = time.perf_counter()
+            got = await c.client.read(2, nm)
+            lat.append(time.perf_counter() - t1)
+            oracle_ok = oracle_ok and got == payload
+        dt_r = time.perf_counter() - t0
+        # ---- repair: wait for the remap + backfill to finish, then
+        # read the ledger (repair MiB/s over the kill-to-clean wall)
+        await c.wait_clean(240)
+        t_clean = time.perf_counter()
+        tot: dict = {}
+        for osd in c.osds:
+            if osd is None:
+                continue
+            for key, val in osd.perf.dump().items():
+                if isinstance(val, (int, float)):
+                    tot[key] = tot.get(key, 0) + val
+        for nm in written[:4]:
+            oracle_ok = oracle_ok and \
+                await c.client.read(2, nm) == payload
+        await c.stop()
+        fetched = int(tot.get("ec_repair_bytes_fetched", 0))
+        rebuilt = int(tot.get("ec_repair_bytes_rebuilt", 0))
+        dt_repair = max(1e-9, t_clean - t_kill)
+        lat_ms = sorted(x * 1e3 for x in lat)
+
+        def pct(p: float) -> float:
+            return round(lat_ms[min(len(lat_ms) - 1,
+                                    int(p * len(lat_ms)))], 1)
+
+        return {
+            "profile": dict(prof),
+            "size": size,
+            "objects": len(written),
+            "write_mib_s": round(
+                len(written) * obj_bytes / dt_w / 2**20, 1),
+            "degraded_read_mib_s": round(
+                len(written) * obj_bytes / dt_r / 2**20, 1),
+            "degraded_read_p50_ms": pct(0.50),
+            "degraded_read_p99_ms": pct(0.99),
+            "repair_mib_s": round(rebuilt / dt_repair / 2**20, 2),
+            "repair_bytes_rebuilt": rebuilt,
+            "repair_bytes_fetched": fetched,
+            "repair_amplification": round(fetched / rebuilt, 2)
+            if rebuilt else 0.0,
+            "repair_subchunk_rebuilds": int(
+                tot.get("ec_repair_subchunk", 0)),
+            "kill_to_clean_s": round(dt_repair, 2),
+            "oracle_ok": oracle_ok,
+            # batching-efficiency ledger (tracked every round like
+            # config 6/8): batched decode dispatches must be > 0 —
+            # host per-stripe fallback would leave them at zero
+            "ec_batches": int(tot.get("ec_batches", 0)),
+            "ec_decode_batches": int(tot.get("ec_decode_batches", 0)),
+            "ec_batch_isolated": int(tot.get("ec_batch_isolated", 0)),
+            "ec_read_crc_err": int(tot.get("ec_read_crc_err", 0)),
+        }
+
+    detail: dict = {"object_bytes": obj_bytes,
+                    "concurrency": concurrency,
+                    "profiles": {}}
+    for name, prof in STORM_PROFILES.items():
+        print(f"config9 {name} ...", file=sys.stderr, flush=True)
+        detail["profiles"][name] = asyncio.run(storm(name, prof))
+        p = detail["profiles"][name]
+        print(f"config9 {name}: write {p['write_mib_s']} MiB/s, "
+              f"degraded p50/p99 {p['degraded_read_p50_ms']}/"
+              f"{p['degraded_read_p99_ms']} ms, repair "
+              f"{p['repair_mib_s']} MiB/s amp "
+              f"{p['repair_amplification']}", file=sys.stderr,
+              flush=True)
+    print(json.dumps(detail))
+    return 0
+
+
 def main() -> None:
     _progress("measuring tunnel latency ...")
     latency = measure_latency()
@@ -1034,6 +1253,7 @@ def main() -> None:
         ("6_rados_bench_ec_k8m3_4MiB", config6_rados_bench),
         ("7_rbd_object_cacher_64KiB_reads", config7_rbd_cache),
         ("8_multichip_ec_k8m3_4MiB", config8_multichip),
+        ("9_recovery_storm_per_codec", config9_recovery_storm),
     ):
         _progress(f"{name} ...")
         result["configs"][name] = fn(latency)
@@ -1049,4 +1269,6 @@ if __name__ == "__main__":
         sys.exit(_multichip_child(int(sys.argv[2]),
                                   int(sys.argv[3])
                                   if len(sys.argv) > 3 else 1))
+    if len(sys.argv) >= 2 and sys.argv[1] == "--recovery-storm-child":
+        sys.exit(_recovery_storm_child())
     main()

@@ -15,6 +15,17 @@ Three checks, scoped to those packages:
   ``astype(float)``) — GF(2^8) and CRC state have no float form;
 - ``+``/``-``/``*`` arithmetic inside GF-named functions, where field
   semantics require XOR / table lookups instead.
+
+The GF(2) bit-plane kernels (ceph_tpu/ops/gf2.py — the bitmatrix
+XOR-schedule dispatch) get the same ctor/float checks PLUS a 64-bit
+promotion check: XOR/popcount lanes must stay uint8/uint32 and gather
+indices int32 — an ``int64``/``uint64`` dtype inside the jitted kernel
+doubles lane traffic and breaks on x64-disabled backends. The GF-arith
+operator check does NOT apply there: GF(2) work is XOR/shift by
+construction, and the integer ``+``/``*`` that remains is index/shape
+arithmetic (unlike GF(2^8) where a stray ``*`` means a missing table
+lookup). placement/ is exempt from the promotion check — straw2 is
+int64 fixed-point BY DESIGN.
 """
 from __future__ import annotations
 
@@ -24,6 +35,10 @@ from typing import Iterator
 from .core import Finding, Rule, ScopedVisitor, call_name, register
 
 _SCOPES = ("ceph_tpu/ec/", "ceph_tpu/checksum/", "ceph_tpu/placement/")
+#: GF(2) bit-plane kernel scope: ctor/float checks + the 64-bit lane
+#: promotion check, but NOT the GF-arith operator check (see module
+#: docstring)
+_GF2_SCOPES = ("ceph_tpu/ops/gf2",)
 
 _NP_MODS = ("np", "jnp", "numpy", "jax.numpy")
 #: constructor -> 0-based positional index where dtype may ride
@@ -35,6 +50,8 @@ _FLOAT_NAMES = frozenset((
     "float16", "float32", "float64", "bfloat16", "float_", "double",
     "half", "single",
 ))
+_WIDE_INT_NAMES = frozenset(("int64", "uint64", "int_", "longlong",
+                             "ulonglong"))
 _GF_MARKERS = ("gf", "galois")
 
 
@@ -58,6 +75,22 @@ def _float_dtype_name(node: ast.AST) -> str | None:
     return None
 
 
+def _wide_int_dtype_name(node: ast.AST) -> str | None:
+    """`np.int64`, bare `int`, or an "int64" string literal — the lane
+    promotions the GF(2) kernel scope forbids."""
+    name = call_name(node)
+    if name:
+        mod, _, leaf = name.rpartition(".")
+        if leaf in _WIDE_INT_NAMES and (not mod or mod in _NP_MODS):
+            return name
+        if name == "int":
+            return name
+    if (isinstance(node, ast.Constant) and isinstance(node.value, str)
+            and node.value.lstrip("<>=").lower() in _WIDE_INT_NAMES):
+        return node.value
+    return None
+
+
 def _in_gf_context(scopes: list[str], path: str) -> bool:
     hay = [s.lower() for s in scopes] + [path.rsplit("/", 1)[-1].lower()]
     return any(m in h for m in _GF_MARKERS for h in hay)
@@ -69,12 +102,14 @@ class DtypeRule(Rule):
 
     def applies(self, path: str) -> bool:
         return any(path.startswith(s) or f"/{s}" in f"/{path}"
-                   for s in _SCOPES)
+                   for s in _SCOPES + _GF2_SCOPES)
 
     def check(self, tree: ast.Module, path: str,
               source: str) -> Iterator[Finding]:
         rule_id = self.id
         findings: list[Finding] = []
+        gf2_scope = any(path.startswith(s) or f"/{s}" in f"/{path}"
+                        for s in _GF2_SCOPES)
 
         class V(ScopedVisitor):
             def visit_Call(self, node: ast.Call) -> None:
@@ -88,6 +123,19 @@ class DtypeRule(Rule):
                             rule_id, path, node.lineno, self.symbol,
                             f"`{name}` without an explicit dtype "
                             "defaults to float64 in a GF/CRC path"))
+                    elif gf2_scope:
+                        # positional dtype must pass the promotion
+                        # check too (np.zeros(n, np.int64))
+                        wide = _wide_int_dtype_name(
+                            node.args[_NEED_DTYPE[ctor]])
+                        if wide is not None:
+                            findings.append(Finding(
+                                rule_id, path, node.lineno,
+                                self.symbol,
+                                f"64-bit dtype `{wide}` in a GF(2) "
+                                "bit-plane kernel — XOR/popcount "
+                                "lanes stay uint8/uint32, indices "
+                                "int32"))
                 for kw in node.keywords:
                     if kw.arg == "dtype":
                         bad = _float_dtype_name(kw.value)
@@ -97,6 +145,16 @@ class DtypeRule(Rule):
                                 self.symbol,
                                 f"float dtype `{bad}` where GF(2^8)/"
                                 "CRC integer words are required"))
+                        if gf2_scope:
+                            wide = _wide_int_dtype_name(kw.value)
+                            if wide is not None:
+                                findings.append(Finding(
+                                    rule_id, path, kw.value.lineno,
+                                    self.symbol,
+                                    f"64-bit dtype `{wide}` in a GF(2)"
+                                    " bit-plane kernel — XOR/popcount "
+                                    "lanes stay uint8/uint32, indices "
+                                    "int32"))
                 if (isinstance(node.func, ast.Attribute)
                         and node.func.attr == "astype" and node.args):
                     bad = _float_dtype_name(node.args[0])
@@ -104,10 +162,22 @@ class DtypeRule(Rule):
                         findings.append(Finding(
                             rule_id, path, node.lineno, self.symbol,
                             f"`.astype({bad})` in a GF(2^8)/CRC path"))
+                    if gf2_scope:
+                        wide = _wide_int_dtype_name(node.args[0])
+                        if wide is not None:
+                            findings.append(Finding(
+                                rule_id, path, node.lineno,
+                                self.symbol,
+                                f"`.astype({wide})` promotes GF(2) "
+                                "lanes to 64 bits inside the kernel"))
                 self.generic_visit(node)
 
             def visit_BinOp(self, node: ast.BinOp) -> None:
-                if (_in_gf_context(self.scope, path)
+                # the GF-arith operator check is GF(2^8)-specific (a
+                # stray `*` means a missing table lookup); GF(2)
+                # kernels legitimately do index/shape arithmetic
+                if (not gf2_scope
+                        and _in_gf_context(self.scope, path)
                         and isinstance(node.op,
                                        (ast.Add, ast.Sub, ast.Mult))
                         and not isinstance(node.left, ast.Constant)

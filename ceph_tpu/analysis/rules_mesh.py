@@ -39,7 +39,7 @@ _SCOPE_FILES = ("ceph_tpu/cluster/ecbatch.py",)
 
 _SANCTIONED = frozenset((
     "shard_rows_to_host", "host_gather",
-    "_encode_sync", "_decode_sync",
+    "_encode_sync", "_decode_sync", "_repair_sync",
     "make_mesh", "_platform_healthy",
 ))
 

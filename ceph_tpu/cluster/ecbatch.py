@@ -70,14 +70,18 @@ def codec_profile_key(codec) -> tuple:
     """Stable bucket identity of a codec: exactly the fields that
     determine its generator matrix and execution engine. ``id(codec)``
     can alias two codecs if one is GC'd and a new one reuses the
-    address — the profile tuple cannot."""
+    address — the profile tuple cannot. Codecs whose geometry goes
+    beyond (k, m) — bitmatrix w, Clay d, LRC layer layout — append it
+    via ``profile_key_extra`` so two different codes never share a
+    bucket (or a compiled plan)."""
+    extra = getattr(codec, "profile_key_extra", None)
     return (
         codec.profile.get("plugin", type(codec).__name__),
         getattr(codec, "technique", ""),
         codec.k,
         codec.m,
         getattr(codec, "backend", ""),
-    )
+    ) + (tuple(extra()) if extra is not None else ())
 
 
 class ECBatcher:
@@ -223,6 +227,18 @@ class ECBatcher:
                tuple(present), tuple(want))
         return await self._submit(key, codec, cells)
 
+    async def repair_cells(self, codec, present, want,
+                           cells: np.ndarray) -> np.ndarray:
+        """Bandwidth-optimal sub-chunk repair (regenerating codes):
+        (B, d, su/q) uint8 helper SLICES — each row a cell's repair
+        planes — rebuild the single lost cell (B, 1, su) uint8. A
+        recovery storm's stripes amortize into one stacked dispatch
+        per (pattern, slice-geometry) bucket; counted with the decode
+        counters (it IS the degraded path's dispatch)."""
+        key = ("rep", codec_profile_key(codec), cells.shape[-1],
+               tuple(present), tuple(want))
+        return await self._submit(key, codec, cells)
+
     def parked(self) -> int:
         """Ops currently awaiting a batcher future (see _parked).
 
@@ -350,6 +366,9 @@ class ECBatcher:
         if key[0] == "enc":
             return await loop.run_in_executor(
                 None, self._encode_sync, codec, cells)
+        if key[0] == "rep":
+            return await loop.run_in_executor(
+                None, self._repair_sync, codec, key[3], key[4], cells)
         return await loop.run_in_executor(
             None, self._decode_sync, codec, key[3], key[4], cells)
 
@@ -479,13 +498,25 @@ class ECBatcher:
         engine = getattr(codec, "resolved_backend", lambda: "device")()
         b, k, su = cells.shape
         if engine == "host" or not hasattr(codec, "encode_crc_batch"):
-            flat = np.ascontiguousarray(
-                cells.transpose(1, 0, 2)).reshape(k, b * su)
-            par = native.rs_encode(codec.matrix, flat,
-                                   threads=os.cpu_count() or 1)
-            parity = np.ascontiguousarray(
-                par.reshape(codec.m, b, su).transpose(1, 0, 2))
-            return parity, None
+            if getattr(codec, "bytewise_linear", False):
+                # GF(2^8) matrix codes: ONE multithreaded C++ matmul
+                # over the shard-major flatten (reads the RMW staging
+                # buffer's contiguous storage back without a copy)
+                flat = np.ascontiguousarray(
+                    cells.transpose(1, 0, 2)).reshape(k, b * su)
+                par = native.rs_encode(codec.matrix, flat,
+                                       threads=os.cpu_count() or 1)
+                parity = np.ascontiguousarray(
+                    par.reshape(codec.m, b, su).transpose(1, 0, 2))
+                return parity, None
+            # cellwise codecs (bitmatrix, CLAY): the plugin's own
+            # vectorized host batch; CRCs stay the caller's separate
+            # multithreaded pass, like every host engine
+            host = getattr(codec, "encode_cells_host", None)
+            if host is not None:
+                return host(cells), None
+            return np.stack([codec.encode_chunks(c) for c in cells]), \
+                None
         mesh = self.mesh()
         if mesh is not None and hasattr(codec, "encode_crc_batch_mesh"):
             return self._mesh_encode_sync(codec, cells, mesh)
@@ -522,12 +553,19 @@ class ECBatcher:
         engine = getattr(codec, "resolved_backend", lambda: "device")()
         b, kp, su = cells.shape
         if engine == "host" or not hasattr(codec, "decode_batch"):
-            mat = codec.decode_matrix_for(present, want)
-            flat = np.ascontiguousarray(
-                cells.transpose(1, 0, 2)).reshape(kp, b * su)
-            out = native.rs_matmul(mat, flat, threads=os.cpu_count() or 1)
-            return np.ascontiguousarray(
-                out.reshape(len(want), b, su).transpose(1, 0, 2))
+            if getattr(codec, "bytewise_linear", False):
+                mat = codec.decode_matrix_for(present, want)
+                flat = np.ascontiguousarray(
+                    cells.transpose(1, 0, 2)).reshape(kp, b * su)
+                out = native.rs_matmul(mat, flat,
+                                       threads=os.cpu_count() or 1)
+                return np.ascontiguousarray(
+                    out.reshape(len(want), b, su).transpose(1, 0, 2))
+            host = getattr(codec, "decode_cells_host", None)
+            if host is not None:
+                return host(present, want, cells)
+            raise RuntimeError(
+                f"codec {type(codec).__name__} has no batched decode")
         mesh = self.mesh()
         mode = self._repair_mode()
         if (mesh is not None and mode != "off"
@@ -538,6 +576,26 @@ class ECBatcher:
 
         batch = ECBatcher._pow2_pad(rs.pack_u32(cells))
         out = codec.decode_batch(present, batch, want=want)
+        return rs.unpack_u32(np.asarray(out)[:b])
+
+    def _repair_sync(self, codec, present: tuple, want: tuple,
+                     cells: np.ndarray) -> np.ndarray:
+        """(B, d, su/q) u8 helper slices -> (B, 1, su) u8 rebuilt
+        cells — the regenerating-code sub-chunk repair dispatch
+        (padded zero stripes repair to zero cells: all-linear)."""
+        engine = getattr(codec, "resolved_backend", lambda: "device")()
+        b = len(cells)
+        if engine == "host" or not hasattr(codec, "repair_batch"):
+            host = getattr(codec, "repair_cells_host", None)
+            if host is None:
+                raise RuntimeError(
+                    f"codec {type(codec).__name__} has no batched "
+                    "sub-chunk repair")
+            return host(present, want, cells)
+        from ..ops import rs
+
+        batch = ECBatcher._pow2_pad(rs.pack_u32(cells))
+        out = codec.repair_batch(present, batch, want)
         return rs.unpack_u32(np.asarray(out)[:b])
 
     def _mesh_decode_sync(self, codec, present: tuple, want: tuple,

@@ -481,8 +481,15 @@ class MECSubRead(Message):
         ("offset", "u64"),
         ("length", "i64"),
         ("trace", "pair:u64:u64"),  # span ctx (utils/trace; 0,0 = off)
+        # sub-chunk repair runs (regenerating codes): packed LE u32
+        # (offset, count) pairs in SUB-CHUNK units, applied within
+        # every cell of the requested range — the shard reads and
+        # hinfo-verifies its full cells locally but replies with only
+        # the selected sub-chunk slices (repair-traffic reduction
+        # without giving up verify-on-read). Empty = whole cells.
+        ("subruns", "bytes"),
     )
-    DEFAULTS = {"trace": (0, 0)}
+    DEFAULTS = {"trace": (0, 0), "subruns": b""}
 
 
 @register_message

@@ -169,6 +169,18 @@ class OSDLite:
         p.add_u64_counter("ec_stray_reads",
                           "reconstructs that widened the candidate pool"
                           " to prior-interval stray shard copies")
+        # repair economics (the metric degraded EC lives on): bytes
+        # FETCHED from surviving shards per bytes REBUILT — k for an
+        # MDS full decode, d/q for a Clay sub-chunk repair, the local
+        # group size for LRC; their ratio is the repair-traffic
+        # amplification bench config 9 reports per codec
+        p.add_u64_counter("ec_repair_bytes_fetched",
+                          "survivor bytes fetched to rebuild shards")
+        p.add_u64_counter("ec_repair_bytes_rebuilt",
+                          "shard bytes rebuilt from survivors")
+        p.add_u64_counter("ec_repair_subchunk",
+                          "shard rebuilds served by the sub-chunk "
+                          "(regenerating-code) repair path")
         p.add_u64_counter("scrubs", "scrub rounds executed")
         p.add_u64_counter("snap_trims", "objects snap-trimmed")
         p.add_u64_counter("pg_splits", "child PGs split from parents")
@@ -299,11 +311,14 @@ class OSDLite:
             from . import stripe as st
 
             codec = self.codec_for(pool)
-            if not getattr(codec, "bytewise_linear", False):
+            if not (getattr(codec, "bytewise_linear", False)
+                    or getattr(codec, "cellwise_codeword", False)):
                 # the striped RMW data path slices chunks into cells,
-                # which is only a valid codeword transform for bytewise
-                # GF-matrix codes (rs_plugin.py); packetized codecs
-                # (bitmatrix, CLAY) would decode garbage
+                # which is a valid codeword transform for bytewise
+                # GF-matrix codes (rs_plugin, lrc) and for CELLWISE
+                # codecs that treat every stripe_unit cell as an
+                # independent codeword (bitmatrix packet rows, CLAY
+                # sub-chunks); anything else would decode garbage
                 raise ValueError(
                     f"EC profile {pool.ec_profile.get('plugin')!r} does "
                     "not support the striped data path (pool "

@@ -96,6 +96,41 @@ def _pad_to(arr: np.ndarray, n: int) -> np.ndarray:
     return out
 
 
+def _pack_subruns(runs: list[tuple[int, int]]) -> bytes:
+    """[(sub_chunk_offset, count)] -> packed LE u32 pairs (the
+    MECSubRead.subruns wire form; a few pairs of control ints)."""
+    return b"".join(o.to_bytes(4, "little") + c.to_bytes(4, "little")
+                    for o, c in runs)
+
+
+def _unpack_subruns(raw: bytes) -> list[tuple[int, int]]:
+    a = np.frombuffer(raw, dtype="<u4").reshape(-1, 2)
+    return [(int(o), int(c)) for o, c in a]
+
+
+def _slice_subruns(chunk: bytes, su: int, subruns: bytes,
+                   codec) -> memoryview:
+    """Per-cell sub-chunk selection: for every su-cell of ``chunk``,
+    keep the (offset, count) sub-chunk runs and concatenate — the
+    shard-side half of the regenerating-code repair plan (the full
+    cells were already hinfo-verified by the caller). Returns a view
+    over the gathered storage: the reply body and the repair staging
+    both consume it un-copied (buffer plane)."""
+    runs = _unpack_subruns(subruns)
+    subs = codec.get_sub_chunk_count()
+    sc = su // subs
+    arr = np.frombuffer(chunk, dtype=np.uint8)
+    if arr.size % su:
+        raise IOError(
+            f"shard length {arr.size} not cell-aligned for sub-chunk "
+            "repair")
+    cells = arr.reshape(-1, su)
+    parts = [cells[:, off * sc : (off + cnt) * sc] for off, cnt in runs]
+    return memoryview(
+        np.ascontiguousarray(np.concatenate(parts, axis=1))
+        .reshape(-1)).toreadonly()
+
+
 def enc_entries(entries: list[Entry]) -> bytes:
     return denc.enc_list(entries, lambda e: e.encode())
 
@@ -2015,9 +2050,15 @@ class PG:
         if ncells == 0:  # nothing fetched anywhere: nothing to rebuild
             return np.zeros((0, len(want_generators), si.su),
                             dtype=np.uint8)
-        if (getattr(codec, "bytewise_linear", False)
+        if ((getattr(codec, "bytewise_linear", False)
+                or getattr(codec, "cellwise_codeword", False))
                 and hasattr(codec, "decode_batch")):
-            order = sorted(chunks)[: codec.k]  # any k rows decode (MDS)
+            order = sorted(chunks)
+            if not getattr(codec, "decode_uses_all_rows", False):
+                # any k rows decode (MDS); LRC/CLAY instead consume
+                # every fetched row (locality plans fetch fewer than
+                # k, Clay's erasure set is the complement)
+                order = order[: codec.k]
             present = tuple(codec._position_to_generator(p)
                             for p in order)
             surv = np.zeros((len(order), ncells * si.su), dtype=np.uint8)
@@ -2028,6 +2069,8 @@ class PG:
                 surv.reshape(len(order), ncells, si.su).transpose(1, 0, 2))
             return await self.osd.ec_batcher.decode_cells(
                 codec, present, want_generators, surv)
+        # chunk-codeword codecs without a batched API: one scalar
+        # codec.decode over whole (padded) chunks
         arrs = {
             p: _pad_to(np.frombuffer(c, dtype=np.uint8), maxlen)
             for p, c in chunks.items()
@@ -2316,7 +2359,12 @@ class PG:
     async def handle_ec_read(self, src: str, m: M.MECSubRead) -> None:
         """Serve a (ranged) shard read: length=-1 is the whole shard
         file, length=0 is metadata only, else a cell-aligned byte range
-        of the shard file; covered cells verify against hinfo."""
+        of the shard file; covered cells verify against hinfo. With
+        ``subruns`` set (regenerating-code repair), the FULL cells are
+        read and hinfo-verified locally — rot must never ride a repair
+        — but only the selected sub-chunk slices of each cell go on
+        the wire (the repair-traffic reduction the sub-chunk plan
+        exists for)."""
         try:
             if self.osd.fault.hit("ec_sub_read", oid=m.oid,
                                   osd=self.osd.id, shard=m.shard):
@@ -2334,9 +2382,13 @@ class PG:
                 # cell must never be rebuilt into another shard; the
                 # knob only relaxes the normal client-read path
                 if (self.osd.conf["osd_ec_verify_on_read"]
-                        or m.length == -1):
+                        or m.length == -1 or m.subruns):
                     self._verify_hinfo(self.cid, m.oid, chunk,
                                        first_cell=m.offset // si.su)
+                if m.subruns:
+                    chunk = _slice_subruns(
+                        chunk, si.su, m.subruns,
+                        self.osd.codec_for(self.pool))
             digest = native.crc32c(np.frombuffer(chunk, np.uint8)) \
                 if chunk else 0
             size = denc.dec_u64(
@@ -3076,14 +3128,102 @@ class PG:
             osd.drop_reply(key)
             return False
 
+    async def _repair_chunk_subchunks(self, oid: bytes, shard: int):
+        """Bandwidth-optimal single-shard rebuild for regenerating
+        codecs (repair_one_lost_chunk over the wire): d helpers each
+        ship only their repair-plane SUB-CHUNKS (1/q of every cell,
+        MECSubRead.subruns) and the batched repair dispatch rebuilds
+        the full shard — repair traffic d/q cell-volumes instead of
+        the k whole chunks an MDS rebuild reads. Returns None whenever
+        the optimal path does not strictly apply (plan not partial,
+        helper failure, version disagreement) so the caller's hardened
+        full reconstruct takes over."""
+        codec = self.osd.codec_for(self.pool)
+        si = self.osd.sinfo_for(self.pool)
+        live = {s: o for o, s in self.live_members()}
+        usable = [s for s in sorted(live) if s != shard]
+        if not codec.is_repair({shard}, set(usable)):
+            return None
+        need = codec.minimum_to_decode([shard], usable)
+        if shard in need or len(need) < codec.d:
+            return None
+        runs = next(iter(need.values()))
+        subs = codec.get_sub_chunk_count()
+        fetched = sum(c for _, c in runs)
+        if fetched >= subs or any(r != runs for r in need.values()):
+            return None  # not actually a partial single-loss plan
+        packed = _pack_subruns(runs)
+        vers: dict[int, tuple[int, int]] = {}
+        size_attrs: dict[int, bytes] = {}
+        attrs_by: dict[int, dict[str, bytes]] = {}
+        chunks: dict[int, bytes] = {}
+        got = await asyncio.gather(
+            *(self._fetch_shard_copy(oid, j, live, vers, size_attrs,
+                                     attrs_by, subruns=packed)
+              for j in sorted(need)),
+            return_exceptions=True)
+        for j, data in zip(sorted(need), got):
+            if isinstance(data, BaseException) or data is None:
+                # transient or unreadable either way: the full path
+                # re-plans with its own retry/fallback machinery
+                return None
+            chunks[j] = data
+        # one consistent generation or bust: the full path owns every
+        # version-skew story (fallback groups, strays, demotions)
+        gens = {vers.get(j, ZERO) for j in chunks}
+        if len(gens) != 1:
+            return None
+        lens = {len(c) for c in chunks.values()}
+        if len(lens) != 1:
+            return None
+        slice_bytes = si.su * fetched // subs
+        total = lens.pop()
+        if slice_bytes == 0 or total == 0 or total % slice_bytes:
+            return None
+        ncells = total // slice_bytes
+        order = sorted(chunks)
+        surv = np.stack([
+            np.frombuffer(chunks[j], dtype=np.uint8)
+            .reshape(ncells, slice_bytes) for j in order
+        ], axis=1)  # (ncells, d, su/q)
+        present_g = tuple(codec._position_to_generator(p)
+                          for p in order)
+        want_g = (codec._position_to_generator(shard),)
+        rebuilt = await self.osd.ec_batcher.repair_cells(
+            codec, present_g, want_g, surv)
+        chunk_arr = np.ascontiguousarray(
+            rebuilt[:, 0, :]).reshape(-1)
+        self.osd.perf.inc("ec_repair_subchunk")
+        self.osd.perf.inc("ec_repair_bytes_fetched",
+                          sum(len(c) for c in chunks.values()))
+        self.osd.perf.inc("ec_repair_bytes_rebuilt", chunk_arr.size)
+        best = max(chunks, key=lambda j: vers.get(j, ZERO))
+        user_attrs: dict[str, bytes] = {}
+        for j in sorted(chunks, key=lambda j: vers.get(j, ZERO)):
+            user_attrs.update(attrs_by.get(j, {}))
+        out_attrs = {
+            **user_attrs,
+            ATTR_SIZE: size_attrs.get(best, denc.enc_u64(0)),
+            ATTR_HINFO: st.enc_hinfo(
+                st.StripeInfo.cell_crcs(chunk_arr, si.su)),
+        }
+        vbest = vers.get(best, ZERO)
+        if vbest != ZERO:
+            out_attrs[ATTR_V] = enc_ver(vbest)
+        return memoryview(chunk_arr).toreadonly(), out_attrs
+
     async def _fetch_shard_copy(self, oid: bytes, j: int,
                                 live: dict[int, int], vers: dict,
-                                size_attrs: dict, attrs_by: dict):
+                                size_attrs: dict, attrs_by: dict,
+                                subruns: bytes = b""):
         """Whole-file, hinfo-verified fetch of shard position ``j``
         from its live holder; records version/size/recovery-attrs and
         returns the chunk bytes, or None when unreadable/absent.
         Local reads pass through the ``ec_read_bitflip`` fault site,
-        and a failed hinfo check counts as ``ec_read_crc_err``."""
+        and a failed hinfo check counts as ``ec_read_crc_err``. With
+        ``subruns`` (regenerating-code repair) only the selected
+        sub-chunk slices of each cell come back — the holder still
+        verifies its full cells."""
         target = live.get(j)
         if target is None:
             return None
@@ -3102,6 +3242,11 @@ class PG:
                                                         oid).items()
                     if _is_recovery_attr(k)
                 }
+                if subruns:
+                    si = self.osd.sinfo_for(self.pool)
+                    chunk = _slice_subruns(
+                        chunk, si.su, subruns,
+                        self.osd.codec_for(self.pool))
                 return chunk
             except HinfoError:
                 self.osd.perf.inc("ec_read_crc_err")
@@ -3115,7 +3260,7 @@ class PG:
                 f"osd.{target}",
                 M.MECSubRead(tid=subtid, pgid=self.pgid, shard=j,
                              oid=oid, offset=0, length=-1,
-                             trace=_trace_ctx()),
+                             subruns=subruns, trace=_trace_ctx()),
             )
             reply = await self.osd.await_reply(subtid, fut, target)
         except Exception:
@@ -3183,8 +3328,21 @@ class PG:
         mixing a revived stale shard's cells with current ones would
         PERSIST wrong bytes under fresh self-consistent CRCs. The
         returned attrs carry the size/recovery attrs AND the ATTR_V of
-        the (max-version) generation the rebuild represents."""
+        the (max-version) generation the rebuild represents.
+
+        Regenerating codecs (Clay) first try the bandwidth-optimal
+        SUB-CHUNK repair: d helpers ship 1/q of their cells instead of
+        k shipping whole chunks (_repair_chunk_subchunks). Any wrinkle
+        — helper failure, version disagreement, a plan that is not
+        actually partial — falls back to this hardened full path."""
         codec = self.osd.codec_for(self.pool)
+        if hasattr(codec, "repair_batch"):
+            try:
+                out = await self._repair_chunk_subchunks(oid, shard)
+            except Exception:
+                out = None  # full path below re-plans from scratch
+            if out is not None:
+                return out
         live = {s: o for o, s in self.live_members()}
         chunks: dict[int, bytes] = {}
         demoted: dict[int, bytes] = {}  # kept for the group fallback
@@ -3279,6 +3437,11 @@ class PG:
         vbest = vers.get(best, ZERO) if best is not None else ZERO
         maxlen = max(len(c) for c in chunks.values()) if chunks else 0
         si = self.osd.sinfo_for(self.pool)
+        # repair economics ledger: survivor bytes fetched per shard
+        # bytes rebuilt (k-to-1 here; the sub-chunk path does better)
+        self.osd.perf.inc("ec_repair_bytes_fetched",
+                          sum(len(c) for c in chunks.values()))
+        self.osd.perf.inc("ec_repair_bytes_rebuilt", maxlen)
         # batched rebuild through the ECBatcher (one stacked-matrix
         # dispatch shared with every other decode in flight); a wanted
         # PARITY shard folds into the recovery matrix, so it is still

@@ -31,6 +31,17 @@ constructions and are exhaustively tested.
 Decode is fully generic: stack the surviving row-blocks of the
 generator [I; B], invert the (k*w)^2 GF(2) system once per erasure
 pattern (cached), XOR-combine surviving packet rows.
+
+Batched device path (the repair-economics pipeline, ops/gf2.py): the
+encode and recovery bitmatrices are precomputed into gather-index XOR
+plans at ``init()``, and ``encode_crc_batch`` / ``decode_batch`` run a
+whole (B, k, su) stripe batch as ONE fused GF(2) bit-plane dispatch —
+parity AND per-cell CRC32Cs from the same program, exactly the
+rs_plugin.encode_crc_batch shape the ECBatcher dispatches through.
+The codec is **cellwise**: each stripe_unit cell is an independent
+codeword (cell = w packet rows of su/w bytes), which is what lets the
+striped RMW data path slice objects into cells — the per-stripe
+oracle is ``encode_chunks``/``decode_chunks`` on (k, su) cells.
 """
 from __future__ import annotations
 
@@ -196,11 +207,55 @@ def _recovery_plan(technique: str, k: int, m: int, w: int,
     return aug[: k * w, k * w :]
 
 
+@functools.lru_cache(maxsize=4096)
+def _want_plan(technique: str, k: int, m: int, w: int,
+               present: tuple[int, ...],
+               want: tuple[int, ...]) -> np.ndarray:
+    """(len(want)*w, len(present)*w) GF(2) matrix producing exactly
+    the ``want`` generator rows' packet rows from the survivors in
+    ``present`` order. A wanted parity row folds the coding bitmatrix
+    over the recovery plan host-side (tiny GF(2) matmul), so a lost
+    parity chunk is STILL one fused dispatch — the rs_plugin
+    _want_matrix_cached trick in GF(2)."""
+    plan = _recovery_plan(technique, k, m, w, present)
+    bm = _bitmatrix(technique, k, m, w)
+    blocks = []
+    for g in want:
+        if g < k:
+            blocks.append(plan[g * w : (g + 1) * w])
+        else:
+            rows = bm[(g - k) * w : (g - k + 1) * w]
+            # GF(2) composition: parity packet rows over data packet
+            # rows, re-expressed over the survivors
+            blocks.append((rows.astype(np.uint32) @
+                           plan.astype(np.uint32) & 1).astype(np.uint8))
+    return np.ascontiguousarray(np.vstack(blocks))
+
+
+@functools.lru_cache(maxsize=4096)
+def _want_xor_plan(technique: str, k: int, m: int, w: int,
+                   present: tuple[int, ...],
+                   want: tuple[int, ...]) -> np.ndarray:
+    """The recovery matrix LOWERED to its gather-index XOR plan —
+    cached per erasure pattern like the matrix itself, so the hot
+    degraded path never recomputes the per-row nonzero scan (the
+    encode side caches its plan once at init)."""
+    from ..ops import gf2
+
+    return gf2.xor_plan(_want_plan(technique, k, m, w, present, want))
+
+
 class BitmatrixCodec(ErasureCode):
     """Generic bitmatrix codec over packet rows."""
 
     DEFAULT_W = {"blaum_roth": 6, "liberation": 7, "liber8tion": 8,
                  "cauchy_bm": 8}
+
+    #: each stripe_unit cell is an independent codeword (w packet rows
+    #: of su/w bytes) — the contract that admits this codec to the
+    #: striped cell data path (osd.sinfo_for) even though arbitrary
+    #: byte slicing of a chunk is NOT a codeword transform
+    cellwise_codeword = True
 
     def init(self, profile) -> None:
         super().init(profile)
@@ -213,12 +268,83 @@ class BitmatrixCodec(ErasureCode):
         self.k = self.to_int("k", 4)
         self.m = self.to_int("m", 2)
         self.w = self.to_int("w", self.DEFAULT_W[self.technique])
+        self.backend = self.profile.get("backend", "device")
+        if self.backend not in ("device", "host", "auto"):
+            raise ECError(
+                f"backend must be device|host|auto, not {self.backend!r}")
         self.matrix = _bitmatrix(self.technique, self.k, self.m, self.w)
+        # the encode XOR plan, precomputed once: gather indices + pad
+        # row feeding the fused GF(2) bit-plane dispatch (ops/gf2.py)
+        from ..ops import gf2
+
+        self._enc_plan = gf2.xor_plan(self.matrix)
         self._parse_mapping()
 
     def get_alignment(self) -> int:
         # each chunk splits into w packet rows of whole words
         return self.k * self.w * 4
+
+    def profile_key_extra(self) -> tuple:
+        """Geometry beyond (k, m) that selects a different code — the
+        ECBatcher bucket key appends this (two w's must never share a
+        compiled plan)."""
+        return (self.w,)
+
+    # --------------------------------------------------- batched (device)
+
+    def resolved_backend(self) -> str:
+        """Engine for the BATCHED cell APIs: "device" (default — the
+        fused GF(2) dispatch is the implementation), "host" (the
+        vectorized numpy reference), or "auto" via the link-economics
+        probe (ec/engine.py)."""
+        if self.backend == "auto":
+            from . import engine
+
+            return engine.data_path_engine()
+        return self.backend
+
+    def encode_crc_batch(self, data, cell_bytes: int):
+        """(B, k, W) uint32 cells -> (parity (B, m, W) uint32, crcs
+        (B, k+m) uint32): one fused GF(2) bit-plane dispatch returns
+        the parity cells AND the per-cell CRC32Cs of data+parity, so
+        hinfo comes back with the parity like rs_plugin."""
+        from ..ops import gf2
+
+        return gf2.jit_encode_with_crcs(self._enc_plan, self.w,
+                                        cell_bytes)(data)
+
+    def decode_batch(self, present: tuple[int, ...], surviving,
+                     want: tuple[int, ...] | None = None):
+        """(B, k', W) uint32 survivor cells (rows in ``present``
+        order) -> (B, len(want), W) uint32 rebuilt cells, one fused
+        dispatch per (pattern, want) plan."""
+        from ..ops import gf2
+
+        if want is None:
+            want = tuple(range(self.k))
+        plan = _want_xor_plan(self.technique, self.k, self.m, self.w,
+                              tuple(present), tuple(want))
+        return gf2.jit_gf2_apply(plan, self.w)(surviving)
+
+    # ------------------------------------------------------ batched (host)
+
+    def encode_cells_host(self, cells: np.ndarray) -> np.ndarray:
+        """(B, k, su) uint8 -> (B, m, su) uint8 — the batcher's host
+        engine for this codec (vectorized numpy, CRCs stay the
+        caller's separate multithreaded pass)."""
+        from ..ops import gf2
+
+        return gf2.gf2_encode_cells_np(self._enc_plan, self.w, cells)
+
+    def decode_cells_host(self, present: tuple[int, ...],
+                          want: tuple[int, ...],
+                          cells: np.ndarray) -> np.ndarray:
+        """(B, k', su) uint8 survivors -> (B, len(want), su) uint8."""
+        from ..ops import gf2
+
+        plan = _want_xor_plan(self.technique, self.k, self.m, self.w,
+                              tuple(present), tuple(want))
+        return gf2.gf2_encode_cells_np(plan, self.w, cells)
 
     def _rows(self, chunks: np.ndarray) -> np.ndarray:
         """(c, L) chunks -> (c*w, L/w) packet rows."""

@@ -27,9 +27,19 @@ Structure:
   planes through the pair partners in its own row.
 
 TPU stance: every per-plane MDS decode with the same erasure pattern is
-the same GF(2^8) matmul — planes of equal intersection score batch into
-one (planes, nodes, sc) kernel dispatch; the host path below is the
-bit-exactness oracle the device path is gated on.
+the same GF(2^8) matmul — and all sub-chunk values are BYTEWISE lanes
+of that algebra, so planes of an intersection-score round AND whole
+stripe batches stack along the value axis into ONE device matmul
+(``_round_mds``). The batched entry points (``encode_crc_batch`` /
+``decode_batch`` / ``repair_batch``) flatten a (B, rows, su) cell
+batch into the last axis of the layered-decode grid, run the exact
+same pairwise-transform + layered recovery machinery, and dispatch
+each score round's MDS as one stacked recovery matmul through
+ops/rs.py — the product-matrix construction of arXiv:1412.3022 riding
+the same fused pipeline as rs_tpu. The codec is **cellwise**: each
+stripe_unit cell is an independent codeword of q^t sub-chunks, which
+is what admits it to the striped cell data path; the scalar host path
+below stays the bit-exactness oracle the device path is gated on.
 """
 from __future__ import annotations
 
@@ -64,11 +74,26 @@ class CLAYCodec(ErasureCode):
     DEFAULT_K = 4
     DEFAULT_M = 2
 
+    #: each stripe_unit cell is an independent codeword (q^t
+    #: sub-chunks) — admits the codec to the striped cell data path
+    #: (osd.sinfo_for); arbitrary byte slicing is NOT a codeword
+    #: transform, cells are
+    cellwise_codeword = True
+
+    #: decode_batch derives the erasure set as the COMPLEMENT of
+    #: ``present``, so the PG must hand it every fetched row, not the
+    #: first k (fewer erasures = smaller per-plane MDS)
+    decode_uses_all_rows = True
+
     def init(self, profile) -> None:
         super().init(profile)
         self.k = self.to_int("k", self.DEFAULT_K)
         self.m = self.to_int("m", self.DEFAULT_M)
         self.d = self.to_int("d", self.k + self.m - 1)
+        self.backend = self.profile.get("backend", "device")
+        if self.backend not in ("device", "host", "auto"):
+            raise ECError(
+                f"backend must be device|host|auto, not {self.backend!r}")
         if self.k < 2 or self.m < 1:
             raise ECError(f"bad clay k={self.k} m={self.m}")
         if not (self.k <= self.d <= self.k + self.m - 1):
@@ -98,6 +123,35 @@ class CLAYCodec(ErasureCode):
 
     def get_sub_chunk_count(self) -> int:
         return self.sub_chunk_no
+
+    def profile_key_extra(self) -> tuple:
+        """d selects a different grid geometry at the same (k, m) —
+        the ECBatcher bucket key appends this."""
+        return (self.d,)
+
+    def resolved_backend(self) -> str:
+        """Engine for the BATCHED cell APIs: routes the stacked
+        per-round MDS matmuls through the device kernels ("device",
+        the default) or the multithreaded C++ host core ("host");
+        "auto" follows the link-economics probe (ec/engine.py). The
+        pairwise transforms are host table lookups either way."""
+        if self.backend == "auto":
+            from . import engine
+
+            return engine.data_path_engine()
+        return self.backend
+
+    #: below this many payload bytes a "device" dispatch is not worth
+    #: a POSSIBLE cold jit (measured 0.1-1.5 s per fresh shape on the
+    #: CPU stand-in): recovery of one small object must never stall
+    #: the repair pipeline on a compile while a thrash is killing the
+    #: next member — the very window acked generations get lost in.
+    #: Big storm batches clear the bar and keep the device economics.
+    DEVICE_MIN_BYTES = 1 << 20
+
+    def _device_dispatch(self, nbytes: int) -> bool:
+        return (self.resolved_backend() == "device"
+                and nbytes >= self.DEVICE_MIN_BYTES)
 
     def get_alignment(self) -> int:
         # every sub-chunk must stay word-aligned: chunk splits into
@@ -192,9 +246,12 @@ class CLAYCodec(ErasureCode):
     # --------------------------------------------------- layered decode
 
     def _decode_layered(self, erased: set[int], C: np.ndarray,
-                        L: int) -> None:
+                        L: int, device: bool = False) -> None:
         """decode_layered role: recover C rows for `erased` nodes (grid
-        node ids) in place. U is materialized alongside."""
+        node ids) in place. U is materialized alongside. The last grid
+        axis is a flat value lane (scalar callers: one sub-chunk;
+        batched callers: the whole stripe batch) — every transform is
+        bytewise, so the same code serves both."""
         q, t = self.q, self.t
         erased = set(erased)
         # pad erasures to exactly m with parity nodes (recomputable)
@@ -215,10 +272,11 @@ class CLAYCodec(ErasureCode):
             # every plane of the round completes its MDS before any
             # U->C recovery runs, because a double-erased pair's
             # conversion needs the companion plane's MDS output from
-            # the SAME round
+            # the SAME round. Planes of a round have no mutual deps,
+            # so the round's MDS solves stack into ONE matmul.
             for z in planes:
                 self._plane_c_to_u(erased, z, C, U)
-                self._plane_mds(erased, z, U)
+            self._round_mds(erased, planes, U, device)
             for z in planes:
                 self._plane_u_to_c(erased, z, C, U)
 
@@ -250,16 +308,52 @@ class CLAYCodec(ErasureCode):
                          1 - me: C[pair[1 - me][0], pair[1 - me][1]]}
                 U[node, z] = _pft_solve(known, [2 + me])[2 + me]
 
-    def _plane_mds(self, erased, z, U) -> None:
-        """decode_uncoupled: per-plane scalar MDS decode of U."""
+    def _round_mds(self, erased, planes: list[int], U,
+                   device: bool = False) -> None:
+        """decode_uncoupled, stacked: ONE recovery matmul rebuilds the
+        erased nodes' U values across every plane of a score round —
+        the per-plane MDS decodes share the erasure pattern, and the
+        values are bytewise GF(2^8) lanes, so they concatenate along
+        the value axis (this is where a stripe batch amortizes too:
+        the lane axis already carries B stripes)."""
+        if not planes or not erased:
+            return
         present_nodes = [i for i in range(self.q * self.t)
                          if i not in erased]
+        want_nodes = sorted(erased)
         # mds generator index: node order = grid order (data+virtual
         # first, then parity) — identical index spaces by construction
-        stack = np.stack([U[i, z] for i in present_nodes])
-        out = self.mds.decode_chunks(present_nodes, stack)
-        for i in erased:
-            U[i, z] = out[i]
+        stack = np.stack([U[i][planes] for i in present_nodes])
+        flat = np.ascontiguousarray(stack).reshape(
+            len(present_nodes), -1)
+        out = self._mds_matmul(tuple(present_nodes),
+                               tuple(want_nodes), flat, device)
+        out = out.reshape(len(want_nodes), len(planes), -1)
+        for wi, i in enumerate(want_nodes):
+            for zi, z in enumerate(planes):
+                U[i, z] = out[wi, zi]
+
+    def _mds_matmul(self, present: tuple[int, ...],
+                    want: tuple[int, ...], flat: np.ndarray,
+                    device: bool) -> np.ndarray:
+        """(P, L) survivor values -> (len(want), L) rebuilt values via
+        the cached recovery matrix: a wanted parity node folds into the
+        matrix (rs_plugin.decode_matrix_for), so the whole round is one
+        stacked matmul — on the device kernels when the batched path
+        asked for them, else the multithreaded C++ host core."""
+        import os as _os
+
+        rmat = self.mds.decode_matrix_for(present, want)
+        if device and flat.shape[1] and flat.shape[1] % 4 == 0:
+            from ..ops import rs
+
+            packed = rs.pack_u32(flat)
+            return rs.unpack_u32(
+                np.asarray(rs.jit_gf_matmul(rmat)(packed)))
+        from .. import native
+
+        return native.rs_matmul(rmat, np.ascontiguousarray(flat),
+                                threads=_os.cpu_count() or 1)
 
     def _plane_u_to_c(self, erased, z, C, U) -> None:
         """decode_layered's recovery loop: C for erased nodes of plane
@@ -364,6 +458,25 @@ class CLAYCodec(ErasureCode):
         if len(want) != 1 or len(chunks) < self.d:
             raise ECError("repair needs exactly 1 want and d helpers")
         lost = next(iter(want))
+        n_rep = self.sub_chunk_no // self.q
+        helpers: dict[int, np.ndarray] = {}
+        for c, buf in chunks.items():
+            arr = _as_u8(buf)
+            if arr.size % n_rep:
+                raise ECError("helper slice not a repair-plane multiple")
+            helpers[self._node(c)] = arr.reshape(n_rep, -1)
+        c_lost = self._repair_core(lost, helpers)
+        return {lost: c_lost.reshape(-1)}
+
+    def _repair_core(self, lost: int, helpers: dict[int, np.ndarray],
+                     device: bool = False) -> np.ndarray:
+        """The plane machinery of repair_one_lost_chunk, over flat
+        value lanes: ``helpers`` maps grid node -> (n_rep, L) values
+        of its repair planes (ascending z); returns the lost chunk's
+        C as (sub_chunk_no, L). L is one sub-chunk for the scalar
+        path, B*sub_chunk for the batched one — every transform is
+        bytewise so both ride the same code, and each score round's
+        MDS solves stack into one matmul (_round_mds)."""
         lost_node = self._node(lost)
         q, t = self.q, self.t
         y0, x0 = lost_node // q, lost_node % q
@@ -373,14 +486,7 @@ class CLAYCodec(ErasureCode):
         ]
         plane_row = {z: i for i, z in enumerate(repair_planes)}
         n_rep = len(repair_planes)
-        helpers: dict[int, np.ndarray] = {}
-        sc = None
-        for c, buf in chunks.items():
-            arr = _as_u8(buf)
-            if arr.size % n_rep:
-                raise ECError("helper slice not a repair-plane multiple")
-            helpers[self._node(c)] = arr.reshape(n_rep, -1)
-            sc = arr.size // n_rep
+        sc = next(iter(helpers.values())).shape[1]
         for v in range(self.k, self.k + self.nu):
             helpers[v] = np.zeros((n_rep, sc), dtype=np.uint8)
         aloof = {
@@ -395,7 +501,11 @@ class CLAYCodec(ErasureCode):
         U = np.zeros((q * t, self.sub_chunk_no, sc), dtype=np.uint8)
         C_lost = np.zeros((self.sub_chunk_no, sc), dtype=np.uint8)
         # plane order: intersection score over {lost row? no — lost +
-        # aloof dots} (reference counts recovered_data + aloof)
+        # aloof dots} (reference counts recovered_data + aloof).
+        # Cross-plane reads (an aloof companion's U) always address a
+        # STRICTLY lower score — the aloof dot counts in this plane's
+        # score and not in the companion's — so planes of one score
+        # round are independent and the round's MDS stacks.
         def score(z):
             zv = self._z_vec(z)
             s = sum(1 for n in aloof if n % q == zv[n // q])
@@ -403,51 +513,222 @@ class CLAYCodec(ErasureCode):
                 s += 1
             return s
 
-        for z in sorted(repair_planes, key=score):
-            zv = self._z_vec(z)
-            # U at every helper/virtual node of this plane
-            for y in range(t):
-                for x in range(q):
-                    node = y * q + x
-                    if node in erased:
-                        continue
-                    pair = self._pair(x, y, z, zv)
-                    if pair is None:
-                        U[node, z] = helpers[node][plane_row[z]]
-                        continue
-                    node_sw = y * q + zv[y]
-                    z_sw = self._z_sw(z, y, x, zv[y])
-                    me = 0 if pair[0] == (node, z) else 1
-                    if node_sw in aloof:
-                        known = {me: helpers[node][plane_row[z]],
-                                 3 - me: U[node_sw, z_sw]}
-                    else:
-                        known = {me: helpers[node][plane_row[z]],
-                                 1 - me: helpers[node_sw][plane_row[z_sw]]}
-                    U[node, z] = _pft_solve(known, [2 + me])[2 + me]
-            # per-plane MDS for erased nodes
-            present_nodes = [i for i in range(q * t) if i not in erased]
-            stack = np.stack([U[i, z] for i in present_nodes])
-            out = self.mds.decode_chunks(present_nodes, stack)
-            for i in erased:
-                U[i, z] = out[i]
+        rounds: dict[int, list[int]] = {}
+        for z in repair_planes:
+            rounds.setdefault(score(z), []).append(z)
+        for iscore in sorted(rounds):
+            planes = rounds[iscore]
+            # U at every helper/virtual node of the round's planes
+            for z in planes:
+                zv = self._z_vec(z)
+                for y in range(t):
+                    for x in range(q):
+                        node = y * q + x
+                        if node in erased:
+                            continue
+                        pair = self._pair(x, y, z, zv)
+                        if pair is None:
+                            U[node, z] = helpers[node][plane_row[z]]
+                            continue
+                        node_sw = y * q + zv[y]
+                        z_sw = self._z_sw(z, y, x, zv[y])
+                        me = 0 if pair[0] == (node, z) else 1
+                        if node_sw in aloof:
+                            known = {me: helpers[node][plane_row[z]],
+                                     3 - me: U[node_sw, z_sw]}
+                        else:
+                            known = {me: helpers[node][plane_row[z]],
+                                     1 - me:
+                                     helpers[node_sw][plane_row[z_sw]]}
+                        U[node, z] = _pft_solve(known, [2 + me])[2 + me]
+            # one stacked MDS for every erased node of every plane in
+            # the round
+            self._round_mds(erased, planes, U, device)
             # recover lost C: directly on repair planes, via row pair
             # partners on companion planes
-            for node in erased:
-                if node in aloof:
-                    continue
-                x, y = node % q, node // q
-                if zv[y] == x:  # the lost node itself (dot here)
-                    C_lost[z] = U[node, z]
-                    continue
-                # row companion: node_sw is the lost node
-                z_sw = self._z_sw(z, y, x, zv[y])
-                pair = self._pair(x, y, z, zv)
-                me = 0 if pair[0] == (node, z) else 1
-                known = {me: helpers[node][plane_row[z]],
-                         2 + me: U[node, z]}
-                C_lost[z_sw] = _pft_solve(known, [1 - me])[1 - me]
-        return {lost: C_lost.reshape(-1)}
+            for z in planes:
+                zv = self._z_vec(z)
+                for node in erased:
+                    if node in aloof:
+                        continue
+                    x, y = node % q, node // q
+                    if zv[y] == x:  # the lost node itself (dot here)
+                        C_lost[z] = U[node, z]
+                        continue
+                    # row companion: node_sw is the lost node
+                    z_sw = self._z_sw(z, y, x, zv[y])
+                    pair = self._pair(x, y, z, zv)
+                    me = 0 if pair[0] == (node, z) else 1
+                    known = {me: helpers[node][plane_row[z]],
+                             2 + me: U[node, z]}
+                    C_lost[z_sw] = _pft_solve(known, [1 - me])[1 - me]
+        return C_lost
+
+    # ------------------------------------------------- batched cell APIs
+
+    def _cells_to_lanes(self, rows: np.ndarray) -> np.ndarray:
+        """(B, n, su) uint8 cells -> (n, sub_chunk_no, B*sc) grid rows:
+        the stripe batch folds into the value lane so the layered
+        machinery runs once for the whole batch."""
+        b, n, su = rows.shape
+        sc = su // self.sub_chunk_no
+        return np.ascontiguousarray(
+            rows.reshape(b, n, self.sub_chunk_no, sc)
+            .transpose(1, 2, 0, 3)).reshape(n, self.sub_chunk_no,
+                                            b * sc)
+
+    def _lanes_to_cells(self, grid_rows: np.ndarray,
+                        b: int) -> np.ndarray:
+        """(n, sub_chunk_no, B*sc) grid rows -> (B, n, su) uint8."""
+        n, subs, lane = grid_rows.shape
+        sc = lane // b
+        return np.ascontiguousarray(
+            grid_rows.reshape(n, subs, b, sc)
+            .transpose(2, 0, 1, 3)).reshape(b, n, subs * sc)
+
+    def _layered_batch(self, present: tuple[int, ...],
+                       cells: np.ndarray, want: tuple[int, ...],
+                       device: bool) -> np.ndarray:
+        """(B, len(present), su) uint8 survivors -> (B, len(want), su)
+        via one batch-wide layered decode."""
+        b, _, su = cells.shape
+        if su % self.sub_chunk_no:
+            raise ECError(
+                f"cell size {su} not a multiple of sub_chunk_count "
+                f"{self.sub_chunk_no}")
+        lanes = self._cells_to_lanes(cells)
+        C = np.zeros((self.q * self.t, self.sub_chunk_no,
+                      lanes.shape[-1]), dtype=np.uint8)
+        for row, chunk in enumerate(present):
+            C[self._node(chunk)] = lanes[row]
+        erased = {
+            self._node(i) for i in range(self.k + self.m)
+            if i not in present
+        }
+        self._decode_layered(erased, C, su, device=device)
+        out = np.stack([C[self._node(g)] for g in want])
+        return self._lanes_to_cells(out, b)
+
+    def encode_crc_batch(self, data, cell_bytes: int):
+        """(B, k, W) uint32 cells -> (parity (B, m, W) uint32, crcs
+        (B, k+m) uint32). The layered construction runs once for the
+        whole batch with each score round's MDS as one stacked device
+        matmul; the per-cell hinfo CRC32Cs come back from one device
+        dispatch over data+parity, rs_plugin-shaped."""
+        import os as _os
+
+        from .. import native
+        from ..ops import rs
+
+        cells = rs.unpack_u32(np.asarray(data))
+        dev = self._device_dispatch(cells.nbytes)
+        parity = self._encode_cells(cells, device=dev)
+        every = np.concatenate([cells, parity], axis=1)
+        if dev:
+            crcs = np.asarray(
+                _jit_cell_crcs(int(cell_bytes))(rs.pack_u32(every)))
+        else:
+            # small batch: the multithreaded C++ CRC pass beats any
+            # possible cold compile (same fused-hinfo contract)
+            b = len(every)
+            crcs = native.crc32c_batch(
+                np.ascontiguousarray(every).reshape(-1, cell_bytes),
+                threads=_os.cpu_count() or 1).reshape(b, -1)
+        return rs.pack_u32(parity), crcs
+
+    def _encode_cells(self, cells: np.ndarray,
+                      device: bool) -> np.ndarray:
+        present = tuple(range(self.k))
+        want = tuple(range(self.k, self.k + self.m))
+        return self._layered_batch(present, cells, want, device)
+
+    def decode_batch(self, present: tuple[int, ...], surviving,
+                     want: tuple[int, ...] | None = None):
+        """(B, k', W) uint32 survivor cells (rows in ``present``
+        order, any k' >= k) -> (B, len(want), W) uint32."""
+        from ..ops import rs
+
+        if want is None:
+            want = tuple(range(self.k))
+        cells = rs.unpack_u32(np.asarray(surviving))
+        out = self._layered_batch(tuple(present), cells, tuple(want),
+                                  device=self._device_dispatch(
+                                      cells.nbytes))
+        return rs.pack_u32(out)
+
+    def repair_batch(self, present: tuple[int, ...], surviving,
+                     want: tuple[int, ...]):
+        """Bandwidth-optimal single-loss repair, batched: surviving
+        (B, d, W/q) uint32 — each helper row is its cell's repair
+        planes (ascending z, 1/q of the cell); returns the rebuilt
+        FULL cells (B, 1, W) uint32. One recovery storm's stripes
+        amortize into each score round's stacked matmul."""
+        from ..ops import rs
+
+        slices = rs.unpack_u32(np.asarray(surviving))  # (B, d, su/q)
+        out = self._repair_cells(tuple(present), slices, tuple(want),
+                                 device=self._device_dispatch(
+                                     slices.nbytes))
+        return rs.pack_u32(out)
+
+    def _repair_cells(self, present: tuple[int, ...],
+                      slices: np.ndarray, want: tuple[int, ...],
+                      device: bool) -> np.ndarray:
+        if len(want) != 1 or len(present) < self.d:
+            raise ECError("repair needs exactly 1 want and d helpers")
+        lost = want[0]
+        b, _, slice_bytes = slices.shape
+        n_rep = self.sub_chunk_no // self.q
+        if slice_bytes % n_rep:
+            raise ECError("helper slice not a repair-plane multiple")
+        sc = slice_bytes // n_rep
+        helpers = {
+            self._node(c):
+            np.ascontiguousarray(
+                slices[:, row].reshape(b, n_rep, sc)
+                .transpose(1, 0, 2)).reshape(n_rep, b * sc)
+            for row, c in enumerate(present)
+        }
+        c_lost = self._repair_core(lost, helpers, device=device)
+        return self._lanes_to_cells(c_lost[None], b)  # (B, 1, su)
+
+    # ------------------------------------------------- batched (host)
+
+    def encode_cells_host(self, cells: np.ndarray) -> np.ndarray:
+        """(B, k, su) uint8 -> (B, m, su) uint8 — the batcher's host
+        engine (same layered machinery, C++ host matmuls)."""
+        return self._encode_cells(
+            np.ascontiguousarray(cells, dtype=np.uint8), device=False)
+
+    def decode_cells_host(self, present: tuple[int, ...],
+                          want: tuple[int, ...],
+                          cells: np.ndarray) -> np.ndarray:
+        return self._layered_batch(
+            tuple(present),
+            np.ascontiguousarray(cells, dtype=np.uint8),
+            tuple(want), device=False)
+
+    def repair_cells_host(self, present: tuple[int, ...],
+                          want: tuple[int, ...],
+                          cells: np.ndarray) -> np.ndarray:
+        return self._repair_cells(
+            tuple(present),
+            np.ascontiguousarray(cells, dtype=np.uint8),
+            tuple(want), device=False)
+
+
+@functools.lru_cache(maxsize=64)
+def _jit_cell_crcs(cell_bytes: int):
+    """Cached jitted per-cell CRC32C pass over (B, n, W) uint32 cells
+    (one device dispatch; the encode side of the fused-CRC contract)."""
+    import jax
+
+    from ..ops import crc32c as crc_ops
+
+    return jax.jit(
+        functools.partial(
+            lambda cb, cells: crc_ops.crc32c_cells_device(cells, cb),
+            int(cell_bytes)))
 
 
 register("clay", CLAYCodec)

@@ -122,6 +122,81 @@ class LRCCodec(ErasureCode):
             range(len(self.layers)),
             key=lambda i: len(self.layers[i].data_pos),
         )
+        # Composite generator: every layer is a bytewise GF(2^8)
+        # matrix code, so the layered composition is one too — feeding
+        # the identity through the layer stack reads the (m, k)
+        # generator off byte-by-byte. This is what lets LRC stripes
+        # ride the SAME fused encode+CRC / stacked-decode device
+        # pipeline as rs_tpu (encode_crc_batch below), while
+        # minimum_to_decode keeps planning locality-sized reads.
+        self.matrix = self.encode_chunks(np.eye(self.k, dtype=np.uint8))
+        self.backend = self.profile.get("backend", "auto")
+        if self.backend not in ("device", "host", "auto"):
+            raise ECError(
+                f"backend must be device|host|auto, not {self.backend!r}")
+        self._rmat_cache: dict[tuple, np.ndarray] = {}
+
+    #: bytewise GF(2^8) linearity (every layer is), so cell/range
+    #: slicing is a codeword transform — same stance as rs_plugin
+    bytewise_linear = True
+
+    #: locality plans fetch FEWER than k chunks; the batched decode
+    #: must receive every fetched row, not the first k
+    decode_uses_all_rows = True
+
+    def profile_key_extra(self) -> tuple:
+        """Same (k, m) with different mapping/layers is a different
+        code — the ECBatcher bucket key appends the layout."""
+        return (self.mapping, self.profile.get("layers", ""))
+
+    # --------------------------------------------------- batched (device)
+
+    def resolved_backend(self) -> str:
+        if self.backend == "auto":
+            from . import engine
+
+            return engine.data_path_engine()
+        return self.backend
+
+    def encode_crc_batch(self, data, cell_bytes: int):
+        """(B, k, W) uint32 -> (parity, per-cell CRCs) in ONE fused
+        device dispatch via the composite generator (rs_plugin shape;
+        parity rows come out in chunk_mapping coding order)."""
+        from ..ops import rs
+
+        return rs.jit_encode_with_crcs(self.matrix, cell_bytes)(data)
+
+    def decode_batch(self, present: tuple[int, ...], surviving,
+                     want: tuple[int, ...] | None = None):
+        """(B, p, W) uint32 survivors (GENERATOR indices in ``present``
+        order — p may be smaller than k for a local repair) ->
+        (B, len(want), W) uint32, one stacked matmul."""
+        from ..ops import rs
+
+        if want is None:
+            want = tuple(range(self.k))
+        rmat = self.decode_matrix_for(tuple(present), tuple(want))
+        return rs.jit_gf_matmul(rmat)(surviving)
+
+    def decode_matrix_for(self, present, want) -> np.ndarray:
+        """Recovery matrix over an arbitrary decodable subset: solve
+        x @ G[present] = G[want] over GF(2^8) (gf8.gf_solve). Unlike
+        the MDS square inverse, ``present`` may be any spanning set —
+        including a local group smaller than k. Raises when the subset
+        cannot determine a wanted row (callers then re-plan)."""
+        from ..ops import gf8 as _gf8
+
+        key = (tuple(present), tuple(want))
+        rmat = self._rmat_cache.get(key)
+        if rmat is None:
+            gen = np.vstack([np.eye(self.k, dtype=np.uint8),
+                             self.matrix])
+            # transpose: solve G[present].T @ X = G[want].T, columns
+            # of X are each wanted row's coefficients over survivors
+            rmat = np.ascontiguousarray(_gf8.gf_solve(
+                gen[list(present)].T, gen[list(want)].T).T)
+            self._rmat_cache[key] = rmat
+        return rmat
 
     def _generate_kml(self) -> None:
         """parse_kml role: k/m/l -> generated mapping + layers."""

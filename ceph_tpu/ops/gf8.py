@@ -114,6 +114,43 @@ def gf_mat_inv(m: np.ndarray) -> np.ndarray:
     return aug[:, n:].copy()
 
 
+def gf_solve(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """Solve A @ X = B over GF(2^8) for a possibly non-square A.
+
+    a: (n, p) uint8, b: (n, r) uint8. Returns X (p, r) uint8 — ANY
+    solution (free variables zero), raising LinAlgError when the
+    system is inconsistent. The locally-repairable-code role: a lost
+    chunk's recovery coefficients over a decodable subset that may be
+    SMALLER than k (a local group), where the square submatrix inverse
+    of decode_matrix does not apply.
+    """
+    a = np.ascontiguousarray(a, dtype=np.uint8)
+    b = np.ascontiguousarray(b, dtype=np.uint8)
+    n, p = a.shape
+    t = mul_table()
+    aug = np.concatenate([a, b], axis=1)
+    pivots: list[tuple[int, int]] = []  # (row, col)
+    row = 0
+    for col in range(p):
+        pivot = next((r for r in range(row, n) if aug[r, col]), None)
+        if pivot is None:
+            continue
+        if pivot != row:
+            aug[[row, pivot]] = aug[[pivot, row]]
+        aug[row] = t[gf_inv(int(aug[row, col])), aug[row]]
+        for r in range(n):
+            if r != row and aug[r, col]:
+                aug[r] ^= t[int(aug[r, col]), aug[row]]
+        pivots.append((row, col))
+        row += 1
+    if aug[row:, p:].any():
+        raise np.linalg.LinAlgError("inconsistent GF(2^8) system")
+    x = np.zeros((p, b.shape[1]), dtype=np.uint8)
+    for r, col in pivots:
+        x[col] = aug[r, p:]
+    return x
+
+
 def vandermonde_rs_matrix(k: int, m: int) -> np.ndarray:
     """Systematic Reed-Solomon coding matrix, Vandermonde construction.
 

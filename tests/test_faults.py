@@ -25,17 +25,30 @@ from ceph_tpu.store import transaction as tx
 
 EC_PROFILE = {"plugin": "rs_tpu", "k": "3", "m": "2", "backend": "device"}
 
+#: repair-economics codec arms: the same seeded thrash (bitrot on
+#: reads + flaps) exercises each codec family's CRC verify-on-read +
+#: async repair path through the batched decode pipeline
+THRASH_PROFILES = {
+    "rs": EC_PROFILE,
+    "clay": {"plugin": "clay", "k": "3", "m": "2",
+             "backend": "device", "stripe_unit": "4096"},
+    "blaum_roth": {"plugin": "bitmatrix", "technique": "blaum_roth",
+                   "k": "3", "m": "2", "backend": "device",
+                   "stripe_unit": "4096"},
+}
+
 
 def run(coro, timeout=180):
     asyncio.run(asyncio.wait_for(coro, timeout))
 
 
-async def make_ec_cluster(n=5, seed=0, pg_num=8):
+async def make_ec_cluster(n=5, seed=0, pg_num=8, profile=None):
     c = TestCluster(n_osds=n, fault_seed=seed)
     await c.start()
     await c.client.create_pool(
         Pool(id=2, name="ec", size=5, min_size=3, pg_num=pg_num,
-             crush_rule=1, type="erasure", ec_profile=dict(EC_PROFILE))
+             crush_rule=1, type="erasure",
+             ec_profile=dict(profile or EC_PROFILE))
     )
     await c.wait_active(20)
     return c
@@ -724,21 +737,33 @@ def test_client_backoff_bounded_exponential_with_jitter():
 # --------------------------------------------------- the thrasher
 
 
-def test_short_thrash_converges_and_replays():
-    """Tier-1 thrash: a seeded ~5 s schedule (flaps + a partition +
-    1% bitrot) under concurrent oracle writers on a k=3,m=2 pool must
-    converge to active+clean, scrub-clean, byte-exact — and the same
-    seed must reproduce the same schedule."""
+@pytest.mark.parametrize("profile", list(THRASH_PROFILES))
+def test_short_thrash_converges_and_replays(profile):
+    """Tier-1 thrash per codec family: a seeded short schedule (flaps
+    [+ a partition on the rs arm] + 1-2% bitrot) under concurrent
+    oracle writers must converge to active+clean, scrub-clean,
+    byte-exact — the same seed reproduces the same schedule, and the
+    non-RS arms prove each codec's verify-on-read + async repair path
+    through the batched decode pipeline (clay, blaum_roth)."""
+    # rs keeps the historical 5 s shape; the codec arms run a leaner
+    # 3 s schedule (bitrot is the point there, not partitions)
+    dur, n_obj, writers, partitions, bitrot = {
+        "rs": (5.0, 6, 3, True, 0.01),
+        "clay": (3.0, 4, 2, False, 0.02),
+        "blaum_roth": (3.0, 4, 2, False, 0.02),
+    }[profile]
+
     async def t():
-        c = await make_ec_cluster(seed=1234, pg_num=8)
+        c = await make_ec_cluster(seed=1234, pg_num=8,
+                                  profile=THRASH_PROFILES[profile])
         c.client.op_timeout = 150.0
-        thr = Thrasher(c, 2, seed=1234, duration=5.0, max_unavail=2,
-                       bitrot_p=0.01, partitions=True, n_objects=6,
-                       obj_size=16 << 10, writers=3,
-                       settle_timeout=90.0)
-        assert thr.schedule == build_schedule(1234, 5.0, 5,
+        thr = Thrasher(c, 2, seed=1234, duration=dur, max_unavail=2,
+                       bitrot_p=bitrot, partitions=partitions,
+                       n_objects=n_obj, obj_size=16 << 10,
+                       writers=writers, settle_timeout=90.0)
+        assert thr.schedule == build_schedule(1234, dur, 5,
                                               max_unavail=2,
-                                              partitions=True)
+                                              partitions=partitions)
         verdict = await thr.run()
         assert verdict["passed"], verdict
         assert verdict["converged"]
@@ -747,6 +772,13 @@ def test_short_thrash_converges_and_replays():
         assert verdict["writes_acked"] > 0
         assert [[e.t, e.kind, e.target] for e in thr.schedule] == \
             verdict["events"]
+        if profile != "rs":
+            # the arm's writes rode the batched cell pipeline (the
+            # degraded-dispatch counter-proof lives in
+            # test_repair_economics — here kills/reads race the heal)
+            enc = sum(o.perf.dump().get("ec_batches", 0)
+                      for o in c.osds if o is not None)
+            assert enc > 0
         await c.stop()
 
     run(t(), timeout=300)

@@ -20,10 +20,44 @@ def run(coro, timeout=480):
     asyncio.run(asyncio.wait_for(coro, timeout))
 
 
+async def wait_quorum(client, n_mons: int, deadline_s: float = 120.0,
+                      require_rank: int | None = None,
+                      strict: bool = False) -> None:
+    """Deadline-poll quorum_status until n_mons ranks (optionally a
+    specific one) sit in the quorum. Under full-suite load mon
+    processes stall behind jax-import compiles, so a paxos commit
+    issued on an unformed quorum times out — the long-standing mon
+    flake. ``strict`` asserts at the deadline; otherwise the caller's
+    own retries get their chance."""
+    import json as _json
+    import time as _time
+
+    deadline = _time.monotonic() + deadline_s
+    while True:
+        try:
+            _, _, outb = await client.mon_command(["quorum_status"])
+            q = _json.loads(outb)["quorum"]
+            if len(q) == n_mons and (require_rank is None
+                                     or require_rank in q):
+                return
+        except (IOError, asyncio.TimeoutError):
+            pass
+        if _time.monotonic() >= deadline:
+            assert not strict, \
+                f"quorum of {n_mons} (rank {require_rank}) never formed"
+            return
+        await asyncio.sleep(0.25)
+
+
 async def make(tmp, n_osds=3, n_mons=1, auth=False, secure=False):
     c = ProcCluster(str(tmp), n_osds=n_osds, n_mons=n_mons,
                     auth=auth, secure=secure)
     await c.start()
+    if n_mons > 1:
+        # ProcCluster.start's quorum wait is bounded best-effort
+        # (30 s): make sure the quorum actually FORMED before the
+        # first pool create issues a paxos commit
+        await wait_quorum(c.client, n_mons)
     await c.client.create_pool(
         Pool(id=1, name="p", size=3, pg_num=8, crush_rule=0))
     await c.wait_active(120)
@@ -155,9 +189,15 @@ def test_multiprocess_mon_leader_kill9(tmp_path):
             # revived mon catches up from its durable store + collect
             # round: bring the old leader back, then kill the CURRENT
             # leader — the next majority (2/3) must include the revived
-            # rank, so a successful quorum commit proves catch-up
+            # rank, so a successful quorum commit proves catch-up.
+            # Deadline-poll the revived rank INTO the quorum before the
+            # kill (a fixed sleep flaked under suite load: killing the
+            # leader while the revived mon was still syncing left no
+            # electable majority and the pool create timed out — the
+            # long-standing "mon flake")
             await c.revive_mon(leader)
-            await asyncio.sleep(2.0)
+            await wait_quorum(c.client, 3, 90, require_rank=leader,
+                              strict=True)
             current = c.leader_mon_rank()
             c.kill_mon(current, signal.SIGKILL)
             await c.client.create_pool(

@@ -44,6 +44,19 @@ def main(argv: list[str] | None = None) -> int:
                          "failover events")
     ap.add_argument("--k", type=int, default=3)
     ap.add_argument("--m", type=int, default=2)
+    ap.add_argument("--profile", default="rs",
+                    choices=("rs", "clay", "blaum_roth", "liberation",
+                             "lrc"),
+                    help="EC codec family for the thrashed pool "
+                         "(default %(default)s). Non-RS arms exercise "
+                         "each repair-economics codec's verify-on-read"
+                         " + batched decode/repair path; blaum_roth/"
+                         "liberation force m=2 (RAID6 codes); lrc "
+                         "splits into two locality groups "
+                         "(l=(k+m)/2) when k and m are even, else "
+                         "one (l=k+m) — stored chunks grow by one "
+                         "local parity per group, so --osds must "
+                         "cover the codec's chunk_count")
     ap.add_argument("--pg-num", type=int, default=8)
     ap.add_argument("--max-unavail", type=int, default=None,
                     help="max simultaneously killed/partitioned OSDs "
@@ -72,6 +85,11 @@ def main(argv: list[str] | None = None) -> int:
                     help="print the deterministic schedule and exit "
                          "(no cluster)")
     args = ap.parse_args(argv)
+    if args.profile in ("blaum_roth", "liberation"):
+        args.m = 2  # RAID6 code families
+    if args.profile == "lrc":
+        # k/m/l generation adds one local parity per group; size grows
+        args.m = max(args.m, 2)
     if args.k < 2 or args.m < 1 or args.osds < args.k + args.m:
         ap.error("need osds >= k + m, k >= 2, m >= 1")
     if args.chip_loss and args.chips % args.mesh_width:
@@ -108,6 +126,25 @@ def main(argv: list[str] | None = None) -> int:
     return 0 if verdict["passed"] else 1
 
 
+def _ec_profile(args, backend: str) -> dict:
+    """ec_profile for the thrashed pool per --profile (the codec arm
+    of the repair-economics pipeline; rs stays the legacy default)."""
+    if args.profile == "rs":
+        return {"plugin": "rs_tpu", "k": str(args.k),
+                "m": str(args.m), "backend": backend}
+    if args.profile in ("blaum_roth", "liberation"):
+        return {"plugin": "bitmatrix", "technique": args.profile,
+                "k": str(args.k), "m": "2", "backend": backend}
+    if args.profile == "clay":
+        return {"plugin": "clay", "k": str(args.k), "m": str(args.m),
+                "backend": backend}
+    # lrc: two locality groups when k and m split evenly, else one
+    groups = 2 if args.k % 2 == 0 and args.m % 2 == 0 else 1
+    l = (args.k + args.m) // groups  # noqa: E741 (reference name)
+    return {"plugin": "lrc", "k": str(args.k), "m": str(args.m),
+            "l": str(l), "backend": backend}
+
+
 async def _run(args, max_unavail: int) -> dict:
     from ceph_tpu.cluster.faults import Thrasher
     from ceph_tpu.cluster.vstart import TestCluster
@@ -126,6 +163,14 @@ async def _run(args, max_unavail: int) -> dict:
             "parallel_repair_mode": "allgather",
         }
         backend = "device"
+    profile = _ec_profile(args, backend)
+    from ceph_tpu.ec import load_codec
+
+    size = load_codec(dict(profile)).get_chunk_count()
+    if args.osds < size:
+        raise SystemExit(
+            f"--profile {args.profile} stores {size} chunks: need "
+            f"--osds >= {size}")
     c = TestCluster(n_osds=args.osds, n_mons=args.mons,
                     fault_seed=args.seed, osd_conf=osd_conf)
     await c.start()
@@ -134,10 +179,9 @@ async def _run(args, max_unavail: int) -> dict:
     # has to exceed the thrash+settle horizon
     c.client.op_timeout = args.duration + args.settle + 60.0
     pool_id = await c.client.create_pool(Pool(
-        id=2, name="thrash", size=args.k + args.m, min_size=args.k,
+        id=2, name="thrash", size=size, min_size=args.k,
         pg_num=args.pg_num, crush_rule=1, type="erasure",
-        ec_profile={"plugin": "rs_tpu", "k": str(args.k),
-                    "m": str(args.m), "backend": backend}))
+        ec_profile=profile))
     await c.wait_active(30)
     thrasher = Thrasher(
         c, pool_id, seed=args.seed, duration=args.duration,
@@ -149,6 +193,19 @@ async def _run(args, max_unavail: int) -> dict:
     try:
         verdict = await thrasher.run()
         verdict["health"] = c.mon.health()
+        verdict["ec_profile"] = args.profile
+        econ: dict = {}
+        for o in c.osds:
+            if o is None:
+                continue
+            d = o.perf.dump()
+            for key in ("ec_batches", "ec_decode_batches",
+                        "ec_batch_isolated", "ec_read_crc_err",
+                        "ec_read_repairs", "ec_repair_subchunk",
+                        "ec_repair_bytes_fetched",
+                        "ec_repair_bytes_rebuilt"):
+                econ[key] = econ.get(key, 0) + int(d.get(key, 0))
+        verdict["ec_counters"] = econ
         if args.chip_loss:
             from ceph_tpu.parallel import runtime
 
