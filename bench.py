@@ -569,6 +569,10 @@ def config6_rados_bench(latency: float) -> dict:
         # at client_max_inflight ops without task-per-op overhead
         comps: list = []
         seq = 0
+        # per-op latency samples (this round's trajectory gains
+        # percentiles next to MiB/s — config 10's fields)
+        lat_w: list = []
+        lat_r: list = []
         # buffer-plane ledger: count flattens/zero-copy sends over the
         # measured phases only (warmup/pool-create marshals excluded)
         BL_STATS.reset()
@@ -578,9 +582,11 @@ def config6_rados_bench(latency: float) -> dict:
         while time.perf_counter() < t_end:
             name = f"b-{seq}"
             seq += 1
-            comps.append((name,
-                          await c.client.aio_write_full(2, name,
-                                                        payload)))
+            comp = await c.client.aio_write_full(2, name, payload)
+            comp.add_done_callback(
+                lambda _c, t1=time.perf_counter():
+                    lat_w.append(time.perf_counter() - t1))
+            comps.append((name, comp))
         await c.client.writes_wait()
         dt_w = time.perf_counter() - t0
         written = []
@@ -594,7 +600,9 @@ def config6_rados_bench(latency: float) -> dict:
 
             async def reader(name: str) -> None:
                 async with sem:
+                    t1 = time.perf_counter()
                     got = await c.client.read(2, name)
+                    lat_r.append(time.perf_counter() - t1)
                     assert len(got) == obj_bytes
 
             t0 = time.perf_counter()
@@ -604,6 +612,7 @@ def config6_rados_bench(latency: float) -> dict:
         batches = stripes = failures = 0
         fail_injected = fail_dispatch = 0
         crc_errs = stale_excl = 0
+        ov_calls = ov_exts = ov_cols = 0
         dec_batches = dec_stripes = 0
         qwait_sum = qwait_n = 0.0
         flush: dict[str, int] = {}
@@ -628,6 +637,9 @@ def config6_rados_bench(latency: float) -> dict:
             fail_dispatch += int(d.get("ec_batch_failures_dispatch", 0))
             crc_errs += int(d.get("ec_read_crc_err", 0))
             stale_excl += int(d.get("ec_read_stale_shard", 0))
+            ov_calls += int(d.get("ov_apply_calls", 0))
+            ov_exts += int(d.get("ov_apply_extents", 0))
+            ov_cols += int(d.get("ov_apply_stripes", 0))
             for key, val in d.items():
                 if str(key).startswith("faults_injected_"):
                     site = str(key)[len("faults_injected_"):]
@@ -649,6 +661,12 @@ def config6_rados_bench(latency: float) -> dict:
                     flush[reason] = flush.get(reason, 0) + int(val)
         ws = dict(c.client.window_stats)
         client_retries = c.client.op_retries
+        # serving-plane ledger: client resolver + every OSD's resolver
+        from ceph_tpu.placement.resolver import PlacementStats
+        place = PlacementStats.aggregate(
+            [c.client.placement_stats()]
+            + [osd.placement.stats.dump() for osd in c.osds
+               if osd is not None])
         bus_bursts = c.bus.delivery_bursts
         bus_frames = c.bus.frames_delivered
         bus_fpd = c.bus.frames_per_drain
@@ -659,6 +677,12 @@ def config6_rados_bench(latency: float) -> dict:
         bl["bus_snapshot_delivery"] = c.bus.snapshot_delivery
         await c.stop()
         from ceph_tpu.ec import engine as ec_engine
+
+        def pct(lat: list, p: float) -> float:
+            if not lat:
+                return 0.0
+            ms = sorted(x * 1e3 for x in lat)
+            return round(ms[min(len(ms) - 1, int(p * len(ms)))], 1)
 
         n = len(written)
         return {
@@ -681,6 +705,24 @@ def config6_rados_bench(latency: float) -> dict:
             "seqread_ops_s": round(n / dt_r, 2) if dt_r else 0.0,
             "seqread_mib_s": round(n * obj_bytes / dt_r / 2**20, 1)
             if dt_r else 0.0,
+            # percentiles join the trajectory this round (same field
+            # shape as config 10): tail latency is the claim MiB/s
+            # alone cannot carry
+            "latency": {
+                "write": {"p50_ms": pct(lat_w, 0.50),
+                          "p99_ms": pct(lat_w, 0.99),
+                          "p999_ms": pct(lat_w, 0.999)},
+                "seqread": {"p50_ms": pct(lat_r, 0.50),
+                            "p99_ms": pct(lat_r, 0.99),
+                            "p999_ms": pct(lat_r, 0.999)},
+            },
+            # vectorized-overlay evidence: ONE staging materialization
+            # per EC write op (ov_apply_calls ~= write ops)
+            "ov_apply_calls": ov_calls,
+            "ov_apply_extents": ov_exts,
+            "ov_apply_stripes": ov_cols,
+            # batched placement service (client + OSD resolvers)
+            "placement": place,
             "objects": n,
             # ---- write-path pipelining occupancy (this PR's seam
             # evidence): how full the client window ran, how many
@@ -1239,6 +1281,71 @@ def _recovery_storm_child() -> int:
     return 0
 
 
+def config10_swarm(_latency: float) -> dict:
+    """Million-object multi-tenant swarm (ROADMAP "serving harness",
+    tools/swarm.py): >= 2,000 simulated clients share four aio windows
+    so ONE process sustains O(10^4) in-flight ops against a live
+    cluster — Zipf-skewed popularity over a million-name space, mixed
+    op shapes (4 KiB PUT/GET, 4 MiB EC stripes, omap index ops) —
+    reporting p50/p99/p999 per shape next to MiB/s, the placement-
+    resolver counter block (batched device lookups > 0, cache hit
+    rate > 90% under the skew is the bar), and two attribution arms:
+    the A/B lever off (CEPH_TPU_PLACEMENT_BATCH=0 equivalent) and a
+    short seeded thrash DURING the swarm (the combined scenario)."""
+    import asyncio
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "ceph_tpu_swarm", os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "tools", "swarm.py"))
+    swarm = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(swarm)
+
+    out = asyncio.run(swarm.run_swarm(
+        clients=2400, duration=8.0, n_osds=10, window=4096,
+        n_rados_clients=4, actor_depth=8, seed=10))
+    place = out.get("placement", {})
+    out["ok"] = (out.get("clients", 0) >= 2000
+                 and out.get("inflight_sustained", 0) >= 10_000
+                 and place.get("placement_batch_lookups", 0) > 0
+                 and place.get("hit_rate", 0.0) > 0.90
+                 and all(s.get("ops", 0) > 0
+                         and "p999_ms" in s
+                         for s in out.get("shapes", {}).values()))
+    # A/B arm: same harness, batched resolver OFF — the attribution
+    # pair for the placement win (smaller scale: the lever's cost
+    # shows in counters and per-op placement work, not wall clock)
+    ab = asyncio.run(swarm.run_swarm(
+        clients=600, duration=4.0, n_osds=10, window=1024,
+        n_rados_clients=2, actor_depth=6, seed=10,
+        placement_batch=False, prewarm=False))
+    out["ab_no_batch"] = {
+        "ops_s": ab["ops_s"],
+        "shapes": {s: {"p50_ms": v["p50_ms"], "p99_ms": v["p99_ms"]}
+                   for s, v in ab["shapes"].items()},
+        "placement": ab["placement"],
+    }
+    # combined scenario: a seeded kill/revive schedule DURING the
+    # swarm; the verdict requires post-heal convergence
+    combined = asyncio.run(swarm.run_swarm(
+        clients=600, duration=6.0, n_osds=10, window=1024,
+        n_rados_clients=2, actor_depth=6, seed=11, thrash_secs=4.0))
+    out["thrash_during_swarm"] = {
+        "converged": combined.get("thrash", {}).get("converged"),
+        "events": combined.get("thrash", {}).get("events"),
+        "ops_s": combined["ops_s"],
+        "op_errors": combined["op_errors"],
+        "placement_epoch_invalidations": combined["placement"].get(
+            "placement_epoch_invalidations", 0),
+        "placement_batch_lookups": combined["placement"].get(
+            "placement_batch_lookups", 0),
+    }
+    out["ok"] = bool(out["ok"]
+                     and out["thrash_during_swarm"]["converged"])
+    return out
+
+
 def main() -> None:
     _progress("measuring tunnel latency ...")
     latency = measure_latency()
@@ -1254,6 +1361,7 @@ def main() -> None:
         ("7_rbd_object_cacher_64KiB_reads", config7_rbd_cache),
         ("8_multichip_ec_k8m3_4MiB", config8_multichip),
         ("9_recovery_storm_per_codec", config9_recovery_storm),
+        ("10_swarm_million_object", config10_swarm),
     ):
         _progress(f"{name} ...")
         result["configs"][name] = fn(latency)

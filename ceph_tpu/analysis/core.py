@@ -102,8 +102,9 @@ def register(cls: type[Rule]) -> type[Rule]:
 def preload() -> None:
     """Import the built-in rule modules (registration is import-time,
     the mon/osd "plugins preload" stance)."""
-    from . import (rules_buffer, rules_dtype, rules_lock,  # noqa: F401
-                   rules_mesh, rules_pipeline, rules_trace, rules_wire)
+    from . import (rules_buffer, rules_dispatch,  # noqa: F401
+                   rules_dtype, rules_lock, rules_mesh,
+                   rules_pipeline, rules_trace, rules_wire)
 
 
 # ------------------------------------------------------------ AST helpers

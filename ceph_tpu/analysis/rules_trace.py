@@ -41,9 +41,13 @@ _HOST_CALLS = frozenset((
 #: arrays — materializing their result on the asyncio reactor thread
 #: blocks the whole daemon for the transfer+execution round trip
 #: (~0.5 s per batch on a tunnel-attached chip); the dispatch AND its
-#: readback belong in an executor worker (cluster/ecbatch.py shape)
+#: readback belong in an executor worker (cluster/ecbatch.py shape).
+#: The bulk-CRUSH serving path (placement/bulk.py do_rule_bulk,
+#: ops/crush.py straw2_bulk) is the same hazard on the dispatch plane:
+#: the placement resolver runs it in an executor, never on the reactor
 _DEVICE_DISPATCHES = frozenset((
     "encode_batch", "decode_batch", "encode_crc_batch",
+    "do_rule_bulk", "straw2_bulk",
 ))
 
 

@@ -15,7 +15,8 @@ import random
 from dataclasses import dataclass, field
 
 from ..placement import encoding as menc
-from ..placement.osdmap import PlacementMemo, Pool
+from ..placement.osdmap import Pool
+from ..placement.resolver import PlacementResolver
 from ..utils import config as cfg
 from ..utils import denc, trace
 from . import messages as M
@@ -80,6 +81,12 @@ class Completion:
     def done(self) -> bool:
         return self._fut.done()
 
+    def add_done_callback(self, fn) -> None:
+        """fn(completion) once the op resolves, success or failure —
+        the latency-sampling hook the bench/swarm harnesses use
+        (librados aio set_complete_callback role)."""
+        self._fut.add_done_callback(lambda _f: fn(self))
+
     async def wait(self):
         """Block until the op completed; returns the MOSDOpReply (outs
         carry per-op outputs) or raises the op's failure."""
@@ -104,7 +111,8 @@ class _InFlight:
 class RadosClient:
     def __init__(self, bus, name: str = "client.0",
                  op_timeout: float = 10.0,
-                 conf: cfg.ConfigProxy | None = None):
+                 conf: cfg.ConfigProxy | None = None,
+                 placement_batch: bool | None = None):
         self.bus = bus
         self.name = name
         self.osdmap = None
@@ -126,7 +134,13 @@ class RadosClient:
         self._map_waiters: list[asyncio.Future] = []
         self._snap_ops: dict[int, asyncio.Future] = {}
         self._watches: dict[tuple[bytes, int], object] = {}
-        self._placement = PlacementMemo()
+        #: the batched placement service (placement/resolver.py):
+        #: epoch-keyed memo, misses coalesced into device bulk-CRUSH
+        #: dispatches on the async path, host fallback always;
+        #: ``placement_batch`` None honors the CEPH_TPU_PLACEMENT_BATCH
+        #: A/B lever, True/False pins it (the swarm harness's arms)
+        self._placement = PlacementResolver(conf=self.conf,
+                                            batch=placement_batch)
         self._next_cookie = 0
         self._tracer = trace.get_tracer(name)
         # ---- aio op window (Objecter in-flight budget role): aio
@@ -184,6 +198,7 @@ class RadosClient:
                        else deadline_s)
 
     async def close(self) -> None:
+        self._placement.close()
         self.bus.unregister(self.name)
 
     # ------------------------------------------------------------ dispatch
@@ -285,7 +300,9 @@ class RadosClient:
                 # to a different (split child) PG
                 op.msg.pgid = self.osdmap.object_to_pg(
                     op.msg.pgid[0], op.msg.oid)
-            op.target = self._calc_target(op.msg.pgid)
+            # a remap storm bounces MANY ops at once — their re-lookups
+            # coalesce on the resolver window like fresh submissions
+            op.target = await self._acalc_target(op.msg.pgid)
             if op.target >= 0:
                 op.msg.epoch = self.osdmap.epoch
                 await self._send_op(op)
@@ -297,8 +314,39 @@ class RadosClient:
     # ------------------------------------------------------------- engine
 
     def _calc_target(self, pgid) -> int:
+        """Sync target calc (map-change resend sweeps): memo hit or an
+        immediate host resolve — never blocks on the batch window."""
         _up, primary = self._placement.up_acting(self.osdmap, pgid)
         return primary
+
+    async def _acalc_target(self, pgid) -> int:
+        """Async target calc for the op path: cache misses park on the
+        resolver's coalescing window so a swarm of concurrent ops (or
+        a remap storm's resends) resolves placement as ONE device
+        bulk-CRUSH dispatch instead of per-op host straw2."""
+        _up, primary = await self._placement.aup_acting(self.osdmap,
+                                                        pgid)
+        return primary
+
+    def placement_stats(self) -> dict[str, int]:
+        """The resolver's counter block (bench/swarm evidence)."""
+        return self._placement.stats.dump()
+
+    async def resolve_targets(self, pool_id: int, names) -> list[int]:
+        """Batch-resolve the primaries for many object names in ONE
+        coalesced placement lookup (the osdc striped fan-out prefetch:
+        a striped op touching N objects warms all N targets with one
+        device dispatch before the sub-ops go out). Names are raw oids
+        — namespace-folding callers fold before calling."""
+        if self.osdmap is None or pool_id not in self.osdmap.pools:
+            await self._wait_pool(pool_id)
+        pgids = [self.osdmap.object_to_pg(
+            pool_id, n.encode() if isinstance(n, str) else bytes(n))
+            for n in names]
+        outs = await asyncio.gather(*(
+            self._placement.aup_acting(self.osdmap, pg)
+            for pg in pgids))
+        return [primary for _up, primary in outs]
 
     async def _send_op(self, op: _InFlight) -> None:
         try:
@@ -335,20 +383,25 @@ class RadosClient:
         from .snaps import NOSNAP
 
         self._tid += 1
+        tid = self._tid
         verb = ops[0][0] if ops else "noop"
         seq, snap_list = snapc if snapc else (0, [])
         with self._tracer.start_span(verb) as span:
             span.tag("pgid", pgid).tag("oid",
                                        oid[:64].decode(errors="replace"))
-            msg = M.MOSDOp(tid=self._tid, pgid=pgid, oid=oid, ops=ops,
+            # placement FIRST (batched: concurrent ops' misses share
+            # one device dispatch), then stamp the epoch — the window
+            # may have spanned a map change and the op must carry the
+            # epoch its target was computed on
+            target = await self._acalc_target(pgid)
+            msg = M.MOSDOp(tid=tid, pgid=pgid, oid=oid, ops=ops,
                            epoch=self.osdmap.epoch, trace=span.ctx,
                            snap_seq=seq, snaps=list(snap_list),
                            snapid=NOSNAP if snapid is None else snapid)
             op = _InFlight(msg=msg, fut=asyncio.get_running_loop()
                            .create_future())
-            tid = self._tid
             self._ops[tid] = op
-            op.target = self._calc_target(pgid)
+            op.target = target
             span.tag("target", op.target)
             if op.target >= 0:
                 await self._send_op(op)

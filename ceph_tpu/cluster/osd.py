@@ -25,7 +25,7 @@ import traceback
 
 from ..ec import load_codec
 from ..placement import encoding as menc
-from ..placement.osdmap import PlacementMemo
+from ..placement.resolver import PlacementResolver
 from ..store import transaction as tx_mod
 from ..store.memstore import MemStore
 from ..utils import config as cfg
@@ -94,12 +94,20 @@ class OSDLite:
             "osd_max_backfills",
             lambda _n, v: (self.local_reserver.set_max(v),
                            self.remote_reserver.set_max(v)))
-        #: per-epoch placement memo (the daemon's map only moves
-        #: by epochs, so memoizing pg->up/acting is safe here)
-        self.placement = PlacementMemo()
+        #: per-epoch placement cache (the daemon's map only moves by
+        #: epochs, so memoizing pg->up/acting is safe here); the
+        #: daemon uses the resolver's SYNC surface — hits are a dict
+        #: read, misses resolve host-side inline — and shares the
+        #: serving plane's counter block
+        self.placement = PlacementResolver(conf=self.conf)
         self.admin: AdminSocket | None = None
         # QoS between client / recovery / scrub traffic (mClock role)
         self.op_scheduler = MClockScheduler()
+        #: mClock tenant classes: client-name prefix -> scheduler
+        #: class (the swarm harness's QoS isolation seam — a bulk
+        #: tenant and a latency tenant land in different dmClock
+        #: classes on the SAME daemon); unmatched entities ride CLIENT
+        self.qos_tenants: dict[str, str] = {}
         #: client write ops currently waiting on a PG lock (see
         #: pg.do_op): they cannot contribute EC stripes until the
         #: holder's batch flushes, so the batcher's idle probe counts
@@ -181,6 +189,17 @@ class OSDLite:
         p.add_u64_counter("ec_repair_subchunk",
                           "shard rebuilds served by the sub-chunk "
                           "(regenerating-code) repair path")
+        # vectorized-overlay evidence (the serving-plane RMW seam):
+        # ONE staging materialization per EC write op, however many
+        # stripes/extents it touches — calls ~= write ops is the proof
+        # the per-stripe apply_range round-trip is gone
+        p.add_u64_counter("ov_apply_calls",
+                          "overlay->staging materializations (one per "
+                          "EC RMW op, not per stripe)")
+        p.add_u64_counter("ov_apply_extents",
+                          "op extents scattered into EC staging")
+        p.add_u64_counter("ov_apply_stripes",
+                          "stripe columns covered by overlay scatters")
         p.add_u64_counter("scrubs", "scrub rounds executed")
         p.add_u64_counter("snap_trims", "objects snap-trimmed")
         p.add_u64_counter("pg_splits", "child PGs split from parents")
@@ -594,7 +613,7 @@ class OSDLite:
                 f"[{','.join(o[0] for o in msg.ops)}]"
             )
             self.op_scheduler.enqueue(
-                CLIENT,
+                self._qos_class(src),
                 lambda src=src, msg=msg, tr=tracked:
                     self._client_op(src, msg, tr),
             )
@@ -679,6 +698,23 @@ class OSDLite:
                            msg.tid), msg)
         elif isinstance(msg, M.MScrubReply):
             self._resolve(msg.tid, msg)
+
+    def set_qos_tenant(self, prefix: str, name: str,
+                       reservation: float, weight: float,
+                       limit: float = 0.0) -> None:
+        """Register an mClock tenant class: ops from client entities
+        whose name starts with ``prefix`` are scheduled under a
+        dedicated dmClock class with its own reservation/weight/limit
+        tags (the osd_mclock_override per-client role). Re-registering
+        a prefix retags future ops only."""
+        self.op_scheduler.add_class(name, reservation, weight, limit)
+        self.qos_tenants[prefix] = name
+
+    def _qos_class(self, src: str) -> str:
+        for prefix, klass in self.qos_tenants.items():
+            if src.startswith(prefix):
+                return klass
+        return CLIENT
 
     async def _client_op(self, src: str, msg: M.MOSDOp,
                          tracked=None) -> None:

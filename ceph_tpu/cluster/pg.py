@@ -541,11 +541,23 @@ class PG:
         #: dict-as-ordered-set so the size cap evicts the OLDEST entry
         #: (an arbitrary eviction could drop a reqid still guarding)
         self._phantom_reqids: dict[tuple, None] = {}
-        #: oid -> loop time of the FIRST of an unbroken run of failed
-        #: reconstructs in peering's peer-recovery push; entries gate
-        #: the unfound classification behind UNFOUND_GRACE and clear on
-        #: a successful push (or at activation)
-        self._unfound_since: dict[bytes, float] = {}
+        #: oid -> (loop time, recovery-progress reading) of the FIRST
+        #: of an unbroken run of failed reconstructs in peering's
+        #: peer-recovery push WITH no recovery progress since; entries
+        #: gate the unfound classification behind UNFOUND_GRACE, and
+        #: the grace RE-ANCHORS whenever any recovery work succeeded
+        #: after the mark — a merely SLOW recovery (cold jit compiles,
+        #: saturated device link, 80 ms reconstructs) keeps advancing
+        #: the counter and never exhausts the grace, while genuine
+        #: bounced-write debris stalls alone once everything else
+        #: recovered and still escapes the wedge (ROADMAP item d: the
+        #: wall clock alone lost acked generations ~1-in-3 under a
+        #: slowed reconstruct at seed 20260803)
+        self._unfound_since: dict[bytes, tuple[float, int]] = {}
+        #: monotone count of recovery work that SUCCEEDED on this
+        #: primary (pushes acked, self-recoveries, own-chunk rebuilds)
+        #: — the progress reading the unfound grace anchors against
+        self._recovery_progress = 0
         #: oid -> newest version whose CONTENT this member lacks even
         #: though its log position claims it (pg_missing_t role):
         #: populated when a head converges over a skipped unfound push
@@ -1427,7 +1439,7 @@ class PG:
                 min((s + 1) * si.width, new_size) - s * si.width,
             )
         )
-        old_parts: dict[int, bytes] = {}
+        old_runs: list[tuple[int, bytes]] = []
         run_start = None
         runs: list[tuple[int, int]] = []
         for s in need_old:
@@ -1444,9 +1456,7 @@ class PG:
             start = a * si.width
             end = min(b * si.width, old_size)
             data, _sz = await self._read_ec(oid, start, end - start)
-            for s in range(a, b):
-                lo = s * si.width - start
-                old_parts[s] = data[lo : lo + si.width]
+            old_runs.append((a, data))
 
         tlist = sorted(touched)
         # Shard-major device STAGING buffer (the bufferlist seam of the
@@ -1463,13 +1473,16 @@ class PG:
         staging = np.zeros((n, len(tlist), si.su), dtype=np.uint8)
         data_sh = staging[:k]                      # (k, T, su)
         par_sh = staging[k:]                       # (m, T, su)
-        for i, s in enumerate(tlist):
-            start = s * si.width
-            end = min(start + si.width, new_size)
-            buf = ov.apply_range(start, end, old_parts.get(s, b""))
-            arr = _pad_to(np.frombuffer(buf, dtype=np.uint8), si.width)
-            data_sh[:, i, :] = arr.reshape(k, si.su)
         if tlist:
+            # vectorized overlay: ONE materialization of the whole
+            # op's extents straight into the staging rows (old stripe
+            # data laid first, extents shadow it) — the per-stripe
+            # apply_range bytearray round-trip is gone, and the
+            # ov_apply_calls counter proves it stays one per op
+            n_ext, n_cols = ov.scatter(data_sh, tlist, si, old_runs)
+            osd.perf.inc("ov_apply_calls")
+            osd.perf.inc("ov_apply_extents", n_ext)
+            osd.perf.inc("ov_apply_stripes", n_cols)
             parity, fused = await osd.ec_batcher.encode_cells(
                 codec, data_sh.transpose(1, 0, 2))
             par_sh[:] = parity.transpose(1, 0, 2)
@@ -2584,10 +2597,7 @@ class PG:
                             # the client saw fail — is skipped, so
                             # peering cannot wedge forever on it
                             # (unfound-object role).
-                            now = asyncio.get_running_loop().time()
-                            since = self._unfound_since.setdefault(
-                                oid, now)
-                            if now - since < UNFOUND_GRACE:
+                            if not self._unfound_grace_spent(oid):
                                 all_acked = False
                                 continue
                             self._unfound_since.pop(oid, None)
@@ -2903,6 +2913,30 @@ class PG:
                 pass
             return False
 
+    def _note_recovery_progress(self) -> None:
+        """Record that some recovery work SUCCEEDED on this primary
+        (push acked, own chunk rebuilt, pull landed). The unfound
+        grace anchors against this reading: while it keeps moving,
+        recovery is merely slow — not wedged — and no acked object
+        may be written off (ROADMAP item d)."""
+        self._recovery_progress += 1
+
+    def _unfound_grace_spent(self, oid: bytes) -> bool:
+        """True only when UNFOUND_GRACE elapsed for ``oid`` with ZERO
+        recovery progress anywhere in this PG — the rollback gate
+        keyed on recovery progress, not wall clock alone. Any progress
+        since the mark re-anchors the grace (and the mark), so a slow
+        grind (delayed reconstructs, cold compiles) never classifies a
+        recoverable acked object unfound, while genuine bounced-write
+        debris — which stalls alone once everything else recovered —
+        still escapes the wedge within one grace period."""
+        now = asyncio.get_running_loop().time()
+        mark = self._unfound_since.get(oid)
+        if mark is None or mark[1] != self._recovery_progress:
+            self._unfound_since[oid] = (now, self._recovery_progress)
+            return False
+        return now - mark[0] >= UNFOUND_GRACE
+
     async def _recover_self(self, best_key, best: PGInfo) -> None:
         """Repair our own copy, THEN adopt the authoritative log: pull
         whole objects from the authoritative peer (replicated) or
@@ -2969,6 +3003,7 @@ class PG:
                             epoch=osd.osdmap.epoch),
                 )
                 await asyncio.wait_for(fut, osd.subop_timeout)
+                self._note_recovery_progress()
         # every object landed (or was recorded missing): NOW the
         # authoritative log is ours
         self.log = best.log
@@ -3007,6 +3042,7 @@ class PG:
                     self.missing.pop(oid, None)
                     self._persist_missing(t)
             self.osd.store.queue_transaction(t)
+            self._note_recovery_progress()
 
     async def _retry_peer_missing(self, o: int, s: int, info: PGInfo,
                                   exclude: dict | None = None) -> None:
@@ -3123,6 +3159,8 @@ class PG:
         )
         try:
             await asyncio.wait_for(fut, osd.subop_timeout)
+            if oid:  # content progress (the head push is log position)
+                self._note_recovery_progress()
             return True
         except asyncio.TimeoutError:
             osd.drop_reply(key)

@@ -74,6 +74,22 @@ class MClockScheduler:
     def __len__(self) -> int:
         return sum(len(c.queue) for c in self._classes.values())
 
+    def add_class(self, name: str, reservation: float, weight: float,
+                  limit: float = 0.0) -> None:
+        """Install (or retune) a service class at runtime — the
+        per-tenant QoS seam: a latency tenant gets a reservation the
+        dequeue loop honors FIRST, a bulk tenant gets weight-only
+        spare capacity, regardless of queue depth. Retuning keeps the
+        queued items and their tags; only future tags move."""
+        prev = self._classes.get(name)
+        state = _ClassState(float(reservation), float(weight),
+                            float(limit))
+        if prev is not None:
+            state.queue = prev.queue
+            state.r_tag, state.p_tag, state.l_tag = (
+                prev.r_tag, prev.p_tag, prev.l_tag)
+        self._classes[name] = state
+
     # ---------------------------------------------------------- enqueue
 
     def enqueue(self, klass: str, payload: Any) -> None:

@@ -36,6 +36,20 @@ class RadosStriper:
     def _size_oid(self, name: str) -> str:
         return name + ".size"
 
+    async def _prefetch_targets(self, extents) -> None:
+        """Warm the placement of every object a striped op touches in
+        ONE coalesced resolver lookup (cluster/client.py
+        resolve_targets): the N concurrent sub-ops below then hit the
+        epoch-keyed cache instead of racing N separate misses.
+        Best-effort — placement is never a liveness dependency."""
+        resolve = getattr(self.client, "resolve_targets", None)
+        if resolve is None:
+            return
+        try:
+            await resolve(self.pool_id, [ex.oid for ex in extents])
+        except Exception:
+            pass  # the per-op path resolves (and retries) on its own
+
     # ------------------------------------------------------------ write
 
     async def write(self, name: str, data: bytes, offset: int = 0,
@@ -46,6 +60,7 @@ class RadosStriper:
         extents = file_to_extents(
             self.layout, offset, len(data), self._fmt(name)
         )
+        await self._prefetch_targets(extents)
 
         async def put(ex):
             piece = bytearray(ex.length)
@@ -82,6 +97,7 @@ class RadosStriper:
         extents = file_to_extents(
             self.layout, offset, length, self._fmt(name)
         )
+        await self._prefetch_targets(extents)
         result = StripedReadResult(length)
 
         async def get(ex):

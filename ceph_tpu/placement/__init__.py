@@ -10,7 +10,13 @@
 - ``osdmap`` — epoch-versioned cluster map: pools, OSD states, the
   object -> PG -> OSD pipeline (reference src/osd/OSDMap.cc:2638-2891),
   upmap overrides, incrementals.
+- ``resolver`` — the batched placement service of the serving plane:
+  epoch-keyed memoized CRUSH results with misses resolved through the
+  device bulk engine in coalesced batches (clients and daemons route
+  placement through it; per-op host straw2 is the fallback, never the
+  path).
 """
-from . import crushmap, osdmap  # noqa: F401
+from . import crushmap, osdmap, resolver  # noqa: F401
 from .crushmap import CrushMap, Rule, Tunables  # noqa: F401
 from .osdmap import OSDMap, Pool  # noqa: F401
+from .resolver import PlacementResolver  # noqa: F401

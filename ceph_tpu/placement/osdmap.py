@@ -349,8 +349,19 @@ class OSDMap:
         self, pgid: tuple[int, int]
     ) -> tuple[list[int], int, list[int], int]:
         """(up, up_primary, acting, acting_primary)."""
-        pool = self.pools[pgid[0]]
         raw, pps = self.pg_to_raw_osds(pgid)
+        return self.raw_to_up_acting(pgid, raw, pps)
+
+    def raw_to_up_acting(
+        self, pgid: tuple[int, int], raw: list[int], pps: int
+    ) -> tuple[list[int], int, list[int], int]:
+        """The post-CRUSH half of the placement pipeline: raw osd
+        vector -> upmap overrides -> up filtering -> primary affinity
+        -> pg_temp/primary_temp. Split out so the batched resolver
+        (placement/resolver.py) can feed DEVICE-computed raw vectors
+        through the exact same host semantics the per-pg path uses —
+        one code path, no drift."""
+        pool = self.pools[pgid[0]]
         raw = self._apply_upmap(pool, pgid, raw)
         up = self._raw_to_up_osds(pool, raw)
         up_primary = self._apply_primary_affinity(pps, pool, up)

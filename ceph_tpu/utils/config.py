@@ -241,6 +241,20 @@ SCHEMA = Schema([
                 "jitter (bounded exponential backoff)"),
     Option("client_backoff_max", "secs", 2.0, min=0.01,
            desc="retry delay ceiling of the client resend loops"),
+    Option("client_placement_batch_window", "secs", 0.002,
+           desc="placement-miss coalescing window: pgid lookups that "
+                "miss the epoch-keyed cache within this long ride ONE "
+                "device bulk-CRUSH dispatch (0 = flush every tick; "
+                "the ECBatcher window discipline on the dispatch "
+                "plane)"),
+    Option("client_placement_batch_target", "int", 64, min=1,
+           desc="placement-miss batch size target: this many queued "
+                "pgids flush ahead of the window deadline"),
+    Option("client_placement_batch_min", "int", 16, min=1,
+           desc="smallest miss batch worth a device dispatch: below "
+                "it the host pipeline resolves inline (a cold jit "
+                "compile would cost more than it saves — the "
+                "DEVICE_MIN_BYTES stance applied to placement)"),
     Option("client_max_inflight", "int", 64, min=1,
            desc="aio op window: ops in flight per client before "
                 "aio submission blocks (objecter_inflight_ops role); "

@@ -809,6 +809,71 @@ def test_thrash_60s_acceptance():
     run(t(), timeout=600)
 
 
+def test_unfound_grace_anchors_on_recovery_progress():
+    """The orphan-rollback gate (ROADMAP item d): UNFOUND_GRACE alone
+    is a wall clock, and a merely SLOW recovery (delayed reconstructs)
+    exhausts it while acked objects are still recoverable — the skip
+    then converges heads over the gap and scrub rolls the generation
+    back. The gate must re-anchor whenever recovery progressed since
+    the mark, and only classify unfound after a full grace with ZERO
+    progress."""
+    async def t():
+        pg = PG.__new__(PG)  # pure gate logic: no cluster needed
+        pg._unfound_since = {}
+        pg._recovery_progress = 0
+        oid = b"debris"
+        # first failure only marks
+        assert not pg._unfound_grace_spent(oid)
+        t0, p0 = pg._unfound_since[oid]
+        assert p0 == 0
+        # wall clock spent but recovery progressed since the mark:
+        # NOT unfound — the mark re-anchors at the new reading
+        pg._unfound_since[oid] = (t0 - UNFOUND_GRACE - 1.0, p0)
+        pg._note_recovery_progress()
+        assert not pg._unfound_grace_spent(oid)
+        t1, p1 = pg._unfound_since[oid]
+        assert p1 == pg._recovery_progress and t1 > t0 - 1.0
+        # grace not yet spent at the new anchor: still not unfound
+        assert not pg._unfound_grace_spent(oid)
+        # a full grace with no progress at all: unfound
+        pg._unfound_since[oid] = (t1 - UNFOUND_GRACE - 1.0, p1)
+        assert pg._unfound_grace_spent(oid)
+
+    run(t(), timeout=10)
+
+
+@pytest.mark.slow
+def test_slow_recovery_keeps_acked_writes(monkeypatch):
+    """ROADMAP item (d) regression: delaying _reconstruct_chunk by
+    ~80 ms per call (a saturated device link / cold-compile shape)
+    made the 20 s seeded thrash lose an acked generation ~1-in-3 on
+    plain rs at seed 20260803 — UNFOUND_GRACE expired while recovery
+    was still grinding, the skip converged heads over the gap, and
+    scrub rolled the orphan back. With the grace anchored on recovery
+    progress the same run stays byte-exact."""
+    orig = PG._reconstruct_chunk
+
+    async def slow_reconstruct(self, oid, shard):
+        await asyncio.sleep(0.08)
+        return await orig(self, oid, shard)
+
+    monkeypatch.setattr(PG, "_reconstruct_chunk", slow_reconstruct)
+
+    async def t():
+        seed = 20260803
+        c = await make_ec_cluster(seed=seed, pg_num=8)
+        c.client.op_timeout = 300.0
+        thr = Thrasher(c, 2, seed=seed, duration=20.0, max_unavail=2,
+                       bitrot_p=0.01, partitions=True, n_objects=8,
+                       obj_size=24 << 10, writers=4,
+                       settle_timeout=150.0)
+        verdict = await thr.run()
+        assert verdict["passed"], verdict
+        await c.stop()
+
+    run(t(), timeout=600)
+
+
 def test_flip_bit_breaks_and_is_deterministic():
     buf = bytes(range(64))
     assert flip_bit(buf) != buf

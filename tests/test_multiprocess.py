@@ -159,6 +159,12 @@ def test_multiprocess_mon_leader_kill9(tmp_path):
         c = ProcCluster(str(tmp_path), n_osds=3, n_mons=3)
         await c.start()
         try:
+            # start()'s quorum wait is bounded best-effort (30 s):
+            # under full-suite load mon boots stall past it, and the
+            # pool create below then issues a paxos commit against an
+            # UNFORMED quorum (the diagnosed mon-flake root) — make()
+            # carries this guard, direct constructions need it too
+            await wait_quorum(c.client, 3)
             await c.client.create_pool(
                 Pool(id=1, name="p", size=3, pg_num=8, crush_rule=0))
             await c.wait_active(90)
@@ -217,6 +223,8 @@ def test_multiprocess_mon_peon_kill9(tmp_path):
         c = ProcCluster(str(tmp_path), n_osds=3, n_mons=3)
         await c.start()
         try:
+            # same unformed-quorum guard as make() / leader_kill9
+            await wait_quorum(c.client, 3)
             await c.client.create_pool(
                 Pool(id=1, name="p", size=3, pg_num=8, crush_rule=0))
             await c.wait_active(90)

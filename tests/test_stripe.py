@@ -128,3 +128,105 @@ def test_hinfo_roundtrip_and_zero_cell():
     assert st.zero_cell_crc(su) == st.StripeInfo(1, 0, su).crc_of_cell(
         np.zeros(su, dtype=np.uint8)
     )
+
+
+# ------------------------------------------------- vectorized scatter
+
+
+def _reference_cells(ov, tlist, si, old_parts):
+    """The legacy per-stripe apply_range materialization — the oracle
+    the one-shot scatter must match byte-for-byte."""
+    k, su, width = si.k, si.su, si.width
+    ref = np.zeros((k, len(tlist), su), dtype=np.uint8)
+    for i, s in enumerate(tlist):
+        start = s * width
+        end = min(start + width, ov.size)
+        buf = ov.apply_range(start, end, old_parts.get(s, b""))
+        arr = np.frombuffer(buf, dtype=np.uint8)
+        pad = np.zeros(width, np.uint8)
+        pad[: len(arr)] = arr
+        ref[:, i, :] = pad.reshape(k, su)
+    return ref
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_overlay_scatter_matches_apply_range(seed):
+    """Property: Overlay.scatter (one strided materialization per op)
+    is byte-identical to the per-stripe apply_range round-trip across
+    random write/zero/truncate mixes, misaligned extents, shrinking
+    rewrites, and partially-covered stripes."""
+    import random
+
+    rng = random.Random(20260803 + seed)
+    for _trial in range(60):
+        k = rng.choice([2, 3, 8])
+        su = rng.choice([4, 16, 64])
+        si = st.StripeInfo(k, rng.choice([1, 2]), su)
+        width = si.width
+        old_size = rng.randrange(0, 6 * width)
+        old = bytes(rng.randrange(1, 256) for _ in range(old_size))
+        ov = st.Overlay(old_size)
+        for _ in range(rng.randrange(0, 6)):
+            op = rng.choice(["write", "zero", "truncate"])
+            off = rng.randrange(0, 8 * width)
+            ln = rng.randrange(1, 3 * width)
+            if op == "write":
+                ov.write(off, bytes(rng.randrange(1, 256)
+                                    for _ in range(ln)))
+            elif op == "zero":
+                ov.zero(off, ln)
+            else:
+                ov.truncate(rng.randrange(0, 8 * width))
+        new_size = ov.size
+        new_nst = si.nstripes(new_size)
+        touched = set()
+        for off, ln in ov.written_ranges():
+            s0, s1 = si.stripe_span(off, ln)
+            touched.update(range(s0, min(s1, new_nst)))
+        if new_size < old_size and new_size % width and new_nst:
+            touched.add(new_nst - 1)
+        need_old = sorted(
+            s for s in touched
+            if s * width < old_size and not ov.covers(
+                s * width, min((s + 1) * width, new_size) - s * width))
+        runs, rs = [], None
+        for s in need_old:
+            if rs is None:
+                rs, prev = s, s
+            elif s == prev + 1:
+                prev = s
+            else:
+                runs.append((rs, prev + 1))
+                rs, prev = s, s
+        if rs is not None:
+            runs.append((rs, prev + 1))
+        old_runs, old_parts = [], {}
+        for a, b in runs:
+            start, end = a * width, min(b * width, old_size)
+            data = old[start:end]
+            old_runs.append((a, data))
+            for s in range(a, b):
+                lo = s * width - start
+                old_parts[s] = data[lo: lo + width]
+        tlist = sorted(touched)
+        dst = np.zeros((k, len(tlist), su), dtype=np.uint8)
+        n_ext, n_cols = ov.scatter(dst, tlist, si, old_runs)
+        assert n_cols == len(tlist)
+        ref = _reference_cells(ov, tlist, si, old_parts)
+        np.testing.assert_array_equal(dst, ref)
+
+
+def test_overlay_scatter_writefull_is_one_strided_assign_shape():
+    """The aligned fast path: a whole-object write covers every cell
+    with one reshape/transpose assign (no fancy indexing) and reports
+    exactly one extent."""
+    si = st.StripeInfo(4, 2, 64)
+    data = bytes(range(256)) * 4  # 4 stripes of 256B width
+    ov = st.Overlay(0)
+    ov.write(0, data)
+    tlist = [0, 1, 2, 3]
+    dst = np.zeros((4, 4, 64), dtype=np.uint8)
+    n_ext, n_cols = ov.scatter(dst, tlist, si, [])
+    assert (n_ext, n_cols) == (1, 4)
+    want = np.frombuffer(data, dtype=np.uint8).reshape(4, 4, 64)
+    np.testing.assert_array_equal(dst, want.transpose(1, 0, 2))

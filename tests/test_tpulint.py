@@ -850,6 +850,104 @@ def test_mesh_discipline_scoped_to_mesh_path():
     assert out == []
 
 
+# ------------------------------------------------- dispatch-discipline
+
+
+def test_dispatch_discipline_host_placement_on_client_fires():
+    out = lint(
+        """
+        class Client:
+            def _calc(self, pgid):
+                up, p = self.osdmap.pg_to_up_acting_osds(pgid)
+                return p
+        """,
+        "ceph_tpu/cluster/client.py", only=["dispatch-discipline"])
+    assert len(out) == 1
+    assert "batched PlacementResolver" in out[0].message
+
+
+def test_dispatch_discipline_memo_ctor_and_do_rule_fire_in_osdc():
+    out = lint(
+        """
+        from ceph_tpu.placement.osdmap import PlacementMemo
+
+        class Striper:
+            def __init__(self):
+                self._memo = PlacementMemo()
+
+            def place(self, crush, rule, pps, size, w):
+                return crush.do_rule(rule, pps, size, w)
+        """,
+        "ceph_tpu/osdc/striper.py", only=["dispatch-discipline"])
+    msgs_ = msgs(out)
+    assert any("PlacementMemo" in m for m in msgs_)
+    assert any("do_rule" in m for m in msgs_)
+
+
+def test_dispatch_discipline_resolver_path_clean():
+    out = lint(
+        """
+        class Client:
+            async def _acalc(self, pgid):
+                up, p = await self._placement.aup_acting(self.osdmap,
+                                                         pgid)
+                return p
+
+            def _calc(self, pgid):
+                up, p = self._placement.up_acting(self.osdmap, pgid)
+                return p
+        """,
+        "ceph_tpu/cluster/client.py", only=["dispatch-discipline"])
+    assert out == []
+
+
+def test_dispatch_discipline_scoped_to_client_tier():
+    # daemons/mon/tools legitimately call the map directly
+    out = lint(
+        """
+        def scan(self, pgid):
+            return self.osdmap.pg_to_up_acting_osds(pgid)
+        """,
+        "ceph_tpu/cluster/osd.py", only=["dispatch-discipline"])
+    assert out == []
+
+
+def test_trace_bulk_crush_readback_on_reactor_fires():
+    # the serving-path extension: materializing a bulk-CRUSH dispatch
+    # on the reactor thread is the same hazard as a codec readback
+    out = lint(
+        """
+        import numpy as np
+
+        class Resolver:
+            async def _run_batch(self, compiled, rule, xs, n, w):
+                return np.asarray(
+                    bulk.do_rule_bulk(compiled, rule, xs, n, w))
+        """,
+        "ceph_tpu/placement/fixture.py", only=["trace-safety"])
+    assert any("do_rule_bulk" in m for m in msgs(out))
+
+
+def test_trace_bulk_crush_executor_shape_clean():
+    # the resolver's real shape: sync worker fn, run_in_executor
+    out = lint(
+        """
+        import numpy as np
+
+        class Resolver:
+            @staticmethod
+            def _bulk_sync(compiled, rule, xs, n, w):
+                out = bulk.do_rule_bulk(compiled, rule, xs, n, w)
+                return np.asarray(out)
+
+            async def _run_batch(self, loop, *a):
+                return await loop.run_in_executor(
+                    None, self._bulk_sync, *a)
+        """,
+        "ceph_tpu/placement/fixture.py", only=["trace-safety"])
+    assert out == []
+
+
 # ------------------------------------------------------------ repo gate
 
 
