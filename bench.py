@@ -1216,6 +1216,52 @@ def _recovery_storm_child() -> int:
         # read the ledger (repair MiB/s over the kill-to-clean wall)
         await c.wait_clean(240)
         t_clean = time.perf_counter()
+        # ---- straggler-tail A/B (ROADMAP "straggler-proof
+        # dispatch"): on the now-clean cluster, arm ONE persistently
+        # slow survivor (lognormal service-time inflation, median
+        # ~250 ms — the order-of-magnitude degradation the SSD-array
+        # study calls production stragglers, and well above both the
+        # 50 ms hedge floor and the substituted-decode cost) and read
+        # the same objects hedged vs CEPH_TPU_HEDGE=0. Running AFTER
+        # the heal keeps the arms symmetric — no background backfill
+        # draining between them — so the p999 gap is purely the tail
+        # the hedged fan-out exists to cut; the hedge ledger shows
+        # what it cost.
+        slow = max(i for i, o in enumerate(c.osds)
+                   if o is not None and i != killed)
+        c.faults.slow_osd([slow], scale=0.25, sigma=0.5)
+        ab: dict = {"slow_osd": slow}
+        sample = written[:24]
+        # one unmeasured hedged pass first: seeds the per-peer EWMAs
+        # with the straggler's service time (a daemon has these warm)
+        # so the measured arms hedge off a converged estimate; the
+        # cold-shape shield keeps substituted-pattern decode compiles
+        # off the measured reads either way
+        for nm in sample:
+            oracle_ok = oracle_ok and \
+                await c.client.read(2, nm) == payload
+        for arm, env in (("unhedged", "0"), ("hedged", "")):
+            if env:
+                os.environ["CEPH_TPU_HEDGE"] = env
+            else:
+                os.environ.pop("CEPH_TPU_HEDGE", None)
+            arm_lat: list = []
+            for _pass in range(3):  # 3 passes: p99 is not max-of-24
+                for nm in sample:
+                    t1 = time.perf_counter()
+                    got = await c.client.read(2, nm)
+                    arm_lat.append((time.perf_counter() - t1) * 1e3)
+                    oracle_ok = oracle_ok and got == payload
+            arm_lat.sort()
+
+            def apct(p: float) -> float:
+                return round(arm_lat[min(len(arm_lat) - 1,
+                                         int(p * len(arm_lat)))], 1)
+
+            ab[arm] = {"p50_ms": apct(0.50), "p99_ms": apct(0.99),
+                       "p999_ms": apct(0.999)}
+        os.environ.pop("CEPH_TPU_HEDGE", None)
+        c.faults.slow_osd([])
         tot: dict = {}
         for osd in c.osds:
             if osd is None:
@@ -1246,6 +1292,15 @@ def _recovery_storm_child() -> int:
                 len(written) * obj_bytes / dt_r / 2**20, 1),
             "degraded_read_p50_ms": pct(0.50),
             "degraded_read_p99_ms": pct(0.99),
+            "degraded_read_p999_ms": pct(0.999),
+            # the straggler A/B arms + the hedge ledger that paid for
+            # them (canceled == fired - won is the leak-free invariant)
+            "degraded_tail": ab,
+            "ec_hedges_fired": int(tot.get("ec_hedges_fired", 0)),
+            "ec_hedges_won": int(tot.get("ec_hedges_won", 0)),
+            "ec_hedges_canceled": int(tot.get("ec_hedges_canceled", 0)),
+            "ec_hedges_wasted_bytes": int(
+                tot.get("ec_hedges_wasted_bytes", 0)),
             "repair_mib_s": round(rebuilt / dt_repair / 2**20, 2),
             "repair_bytes_rebuilt": rebuilt,
             "repair_bytes_fetched": fetched,
@@ -1271,10 +1326,15 @@ def _recovery_storm_child() -> int:
         print(f"config9 {name} ...", file=sys.stderr, flush=True)
         detail["profiles"][name] = asyncio.run(storm(name, prof))
         p = detail["profiles"][name]
+        tail = p["degraded_tail"]
         print(f"config9 {name}: write {p['write_mib_s']} MiB/s, "
-              f"degraded p50/p99 {p['degraded_read_p50_ms']}/"
-              f"{p['degraded_read_p99_ms']} ms, repair "
-              f"{p['repair_mib_s']} MiB/s amp "
+              f"degraded p50/p99/p999 {p['degraded_read_p50_ms']}/"
+              f"{p['degraded_read_p99_ms']}/"
+              f"{p['degraded_read_p999_ms']} ms, straggler p999 "
+              f"hedged {tail['hedged']['p999_ms']} vs unhedged "
+              f"{tail['unhedged']['p999_ms']} ms (hedges "
+              f"{p['ec_hedges_won']}/{p['ec_hedges_fired']} won), "
+              f"repair {p['repair_mib_s']} MiB/s amp "
               f"{p['repair_amplification']}", file=sys.stderr,
               flush=True)
     print(json.dumps(detail))

@@ -22,10 +22,11 @@ The rule flags, inside ``ceph_tpu/parallel/`` and the batcher module
 Sanctioned boundaries, by function name: the per-device view reader
 (``shard_rows_to_host``), the counted gather (``host_gather``), the
 single-device engine boundary the batcher already owns
-(``_encode_sync`` / ``_decode_sync`` — their mesh siblings are NOT
-sanctioned, they must route through the view reader), and the two
-host-side helpers that touch device lists, not data (``make_mesh``,
-``_platform_healthy``).
+(``_encode_sync`` / ``_decode_sync`` and the ``_dispatch_block``
+row-block closures of the over-decomposed dispatch — their mesh
+siblings are NOT sanctioned, they must route through the view
+reader), and the two host-side helpers that touch device lists, not
+data (``make_mesh``, ``_platform_healthy``).
 """
 from __future__ import annotations
 
@@ -40,6 +41,7 @@ _SCOPE_FILES = ("ceph_tpu/cluster/ecbatch.py",)
 _SANCTIONED = frozenset((
     "shard_rows_to_host", "host_gather",
     "_encode_sync", "_decode_sync", "_repair_sync",
+    "_dispatch_block",
     "make_mesh", "_platform_healthy",
 ))
 

@@ -49,6 +49,7 @@ from __future__ import annotations
 
 import asyncio
 import os
+import threading
 
 import numpy as np
 
@@ -64,6 +65,18 @@ _FAILED = object()
 #: tick      — per-reactor-tick flush (window disabled)
 #: drain     — an in-flight batch completed and the next buffer flushed
 FLUSH_REASONS = ("size", "deadline", "fast", "tick", "drain")
+
+#: a decode/repair survivor pattern promotes from the host engine to
+#: the device engine only after it has moved this many bytes through
+#: the batcher — where a 0.1-1.5 s fresh-shape kernel compile (the
+#: DEVICE_MIN_BYTES math in the CLAY plugin) amortizes against the
+#: per-byte device advantage. A quarter-GiB of ONE erasure pattern is
+#: a recovery storm rebuilding a whole OSD, not a run of degraded
+#: reads: storms cross this within their first stacked rounds, while
+#: the one-off patterns hedge substitution manufactures never do and
+#: never pay the compile. Override: osd_ec_cold_shape_bytes (0
+#: disables the shield).
+COLD_SHAPE_BYTES = 256 << 20
 
 
 def codec_profile_key(codec) -> tuple:
@@ -119,6 +132,12 @@ class ECBatcher:
         #: platform that cannot supply the mesh (graceful degrade)
         self._mesh_resolved = False
         self._mesh_cached = None
+        #: cumulative bytes dispatched per decode/repair survivor
+        #: pattern — the cold-shape shield's ledger (see _cold_shape)
+        self._shape_bytes: dict[tuple, int] = {}
+        #: promotion state per pattern: False = device kernel compile
+        #: warming in the background, True = warm (device path open)
+        self._shape_warm: dict[tuple, bool] = {}
 
     @staticmethod
     def declare_counters(perf) -> None:
@@ -143,6 +162,23 @@ class ECBatcher:
         perf.add_u64_counter("ec_mesh_decode_dispatches",
                              "decode/repair dispatches run as mesh "
                              "collectives (parallel_repair_mode)")
+        perf.add_u64_counter("ec_overdecompose_rounds",
+                             "decode/repair dispatches run rateless-"
+                             "over-decomposed into row-block sub-tasks")
+        perf.add_u64_counter("ec_overdecompose_subtasks",
+                             "row-block sub-task copies dispatched by "
+                             "over-decomposed rounds (primary + hedge "
+                             "duplicate per block)")
+        perf.add_u64_counter("ec_overdecompose_shed",
+                             "stale sub-task copies shed (cancelled, "
+                             "or landed after their block had already "
+                             "resolved)")
+        perf.add_u64_counter("ec_decode_cold_host",
+                             "decode/repair rounds dispatched on the "
+                             "host engine because their survivor "
+                             "pattern was still cold (cold-shape "
+                             "shield: a waiting read never stalls on "
+                             "a fresh-kernel device compile)")
         perf.add_u64_counter("ec_decode_batches",
                              "batched EC decode dispatches")
         perf.add_histogram("ec_decode_stripes",
@@ -170,6 +206,22 @@ class ECBatcher:
             return float(self.conf["osd_ec_batch_window"])
         except Exception:
             return 0.0
+
+    def _overdecompose_factor(self) -> int:
+        if self.conf is None:
+            return 0
+        try:
+            return int(self.conf["osd_ec_overdecompose"])
+        except Exception:
+            return 0
+
+    def _cold_shape_bytes(self) -> int:
+        if self.conf is None:
+            return COLD_SHAPE_BYTES
+        try:
+            return int(self.conf["osd_ec_cold_shape_bytes"])
+        except Exception:
+            return COLD_SHAPE_BYTES
 
     def _repair_mode(self) -> str:
         if self.conf is None:
@@ -547,36 +599,205 @@ class ECBatcher:
             self.perf.inc("ec_mesh_encode_dispatches")
         return rs.unpack_u32(parity[:b]), crcs[:b]
 
+    def _overdecomposed(self, cells: np.ndarray, run):
+        """Rateless recovery over-decomposition (arXiv:1804.10331) —
+        the device-tier half of straggler-proof dispatch. The batched
+        recovery matmul splits along its batch axis into
+        ``osd_ec_overdecompose`` x workers row blocks (rs.row_blocks);
+        every block is dispatched TWICE across a bounded worker pool
+        (primary + one hedge duplicate), the first copy per block to
+        land wins, and stale copies are shed — so a straggling worker
+        (slow chip, contended core) sheds work instead of gating the
+        round. Byte-exact by construction: both copies of a block run
+        the SAME kernel over the SAME rows, and the blocks partition
+        the batch. Returns None when the knob is off or the batch is
+        too small to split (the legacy single dispatch)."""
+        factor = self._overdecompose_factor()
+        n = len(cells)
+        if factor <= 0 or n < 2:
+            return None
+        import concurrent.futures as cf
+
+        from ..ops import rs
+
+        devs = getattr(self.mesh(), "devices", None)
+        workers = int(getattr(devs, "size", 0) or 0)
+        if workers <= 0:
+            workers = min(4, os.cpu_count() or 1)
+        blocks = rs.row_blocks(n, factor * workers)
+        if len(blocks) <= 1:
+            return None
+        if self.perf is not None:
+            self.perf.inc("ec_overdecompose_rounds")
+            self.perf.inc("ec_overdecompose_subtasks", 2 * len(blocks))
+        results: list = [None] * len(blocks)
+        remaining = [2] * len(blocks)
+        shed = 0
+        with cf.ThreadPoolExecutor(max_workers=workers) as pool:
+            futs = {}
+            for i, (lo, hi) in enumerate(blocks):
+                for _copy in range(2):
+                    futs[pool.submit(run, cells[lo:hi])] = i
+            pending = set(futs)
+            try:
+                while pending:
+                    done, pending = cf.wait(
+                        pending, return_when=cf.FIRST_COMPLETED)
+                    for f in done:
+                        i = futs[f]
+                        remaining[i] -= 1
+                        if results[i] is not None:
+                            shed += 1  # landed after its twin won
+                            continue
+                        try:
+                            results[i] = f.result()
+                        except Exception:
+                            # one copy of a block failing is survivable
+                            # (its twin may land); both failing is the
+                            # dispatch failure — propagate it and let
+                            # _run's fail-closed isolation take over
+                            if remaining[i] == 0:
+                                raise
+                    if all(r is not None for r in results):
+                        # every pending copy is now stale: cancelled if
+                        # unstarted, else drained by pool shutdown with
+                        # its result discarded — shed either way
+                        shed += len(pending)
+                        break
+            finally:
+                for f in pending:
+                    f.cancel()
+        if self.perf is not None and shed:
+            self.perf.inc("ec_overdecompose_shed", shed)
+        return np.concatenate(results)
+
+    def _cold_shape(self, key: tuple, nbytes: int, warm) -> bool:
+        """True while a decode/repair survivor pattern is still cold —
+        the cold-shape shield. Device decode kernels specialize per
+        (pattern, geometry): dispatching a novel pattern risks the
+        0.1-1.5 s fresh-shape compile clay's DEVICE_MIN_BYTES
+        documents, and a hedged read that just cut an 80 ms straggler
+        wait must not spend the savings on a compile stall (hedge
+        substitution is exactly what manufactures novel survivor
+        patterns at client-latency-critical time). A pattern stays on
+        the host engine until its cumulative bytes cross
+        osd_ec_cold_shape_bytes — the volume where the compile
+        amortizes — and even then the promotion runs ``warm`` (one
+        device dispatch) on a background thread first, so the compile
+        itself never sits on a waiting read: rounds keep landing host
+        until the kernel is warm. Storm patterns (one erasure hit
+        across a PG's objects) promote within a few stacked rounds;
+        the one-off patterns hedging manufactures never do, and never
+        pay the compile."""
+        threshold = self._cold_shape_bytes()
+        if threshold <= 0:
+            return False
+        seen = self._shape_bytes.get(key, 0)
+        if seen < threshold:
+            self._shape_bytes[key] = seen + nbytes
+            return True
+        state = self._shape_warm.get(key)
+        if state is True:
+            return False
+        if state is None:
+            self._shape_warm[key] = False
+
+            def _warm_kernel():
+                try:
+                    warm()
+                finally:
+                    # even a failed warm opens the device path: the
+                    # real dispatch will surface the error (and the
+                    # shield must not pin a pattern to the host
+                    # forever on a transient)
+                    self._shape_warm[key] = True
+            threading.Thread(target=_warm_kernel, daemon=True,
+                             name="ec-shape-warm").start()
+        return True
+
+    def _host_decode_block(self, codec, present: tuple, want: tuple,
+                           kp: int, su: int):
+        """Host-engine row-block dispatcher for decode, or None when
+        the codec has no host hook."""
+        if getattr(codec, "bytewise_linear", False):
+            mat = codec.decode_matrix_for(present, want)
+
+            def _dispatch_block(blk: np.ndarray) -> np.ndarray:
+                bb = len(blk)
+                flat = np.ascontiguousarray(
+                    blk.transpose(1, 0, 2)).reshape(kp, bb * su)
+                out = native.rs_matmul(mat, flat,
+                                       threads=os.cpu_count() or 1)
+                return np.ascontiguousarray(
+                    out.reshape(len(want), bb, su)
+                    .transpose(1, 0, 2))
+            return _dispatch_block
+        host = getattr(codec, "decode_cells_host", None)
+        if host is None:
+            return None
+
+        def _dispatch_block(blk: np.ndarray) -> np.ndarray:
+            return host(present, want, blk)
+        return _dispatch_block
+
+    def _host_repair_block(self, codec, present: tuple, want: tuple):
+        """Host-engine row-block dispatcher for sub-chunk repair, or
+        None when the codec has no host hook."""
+        host = getattr(codec, "repair_cells_host", None)
+        if host is None:
+            return None
+
+        def _dispatch_block(blk: np.ndarray) -> np.ndarray:
+            return host(present, want, blk)
+        return _dispatch_block
+
     def _decode_sync(self, codec, present: tuple, want: tuple,
                      cells: np.ndarray) -> np.ndarray:
         """(B, k', su) u8 survivors -> (B, len(want), su) u8."""
         engine = getattr(codec, "resolved_backend", lambda: "device")()
         b, kp, su = cells.shape
         if engine == "host" or not hasattr(codec, "decode_batch"):
-            if getattr(codec, "bytewise_linear", False):
-                mat = codec.decode_matrix_for(present, want)
-                flat = np.ascontiguousarray(
-                    cells.transpose(1, 0, 2)).reshape(kp, b * su)
-                out = native.rs_matmul(mat, flat,
-                                       threads=os.cpu_count() or 1)
-                return np.ascontiguousarray(
-                    out.reshape(len(want), b, su).transpose(1, 0, 2))
-            host = getattr(codec, "decode_cells_host", None)
-            if host is not None:
-                return host(present, want, cells)
-            raise RuntimeError(
-                f"codec {type(codec).__name__} has no batched decode")
-        mesh = self.mesh()
-        mode = self._repair_mode()
-        if (mesh is not None and mode != "off"
-                and hasattr(codec, "decode_batch_mesh")):
-            return self._mesh_decode_sync(codec, present, want, cells,
-                                          mesh, mode)
-        from ..ops import rs
+            _dispatch_block = self._host_decode_block(codec, present,
+                                                      want, kp, su)
+            if _dispatch_block is None:
+                raise RuntimeError(
+                    f"codec {type(codec).__name__} has no batched "
+                    "decode")
+        else:
+            mesh = self.mesh()
+            mode = self._repair_mode()
+            if (mesh is not None and mode != "off"
+                    and hasattr(codec, "decode_batch_mesh")):
+                # the collective path already distributes ONE matmul
+                # across every chip with its own combine — slicing its
+                # batch would serialize collectives, so it keeps its
+                # own distribution and skips over-decomposition (and
+                # the cold-shape shield: mesh rounds are storm-sized)
+                return self._mesh_decode_sync(codec, present, want,
+                                              cells, mesh, mode)
+            from ..ops import rs
 
-        batch = ECBatcher._pow2_pad(rs.pack_u32(cells))
-        out = codec.decode_batch(present, batch, want=want)
-        return rs.unpack_u32(np.asarray(out)[:b])
+            def _dispatch_block(blk: np.ndarray) -> np.ndarray:
+                bb = len(blk)
+                batch = ECBatcher._pow2_pad(rs.pack_u32(blk))
+                out = codec.decode_batch(present, batch, want=want)
+                return rs.unpack_u32(np.asarray(out)[:bb])
+            if ((getattr(codec, "bytewise_linear", False)
+                    or getattr(codec, "decode_cells_host", None)
+                    is not None)
+                    and self._cold_shape(
+                        ("dec", codec_profile_key(codec), su,
+                         present, want), cells.nbytes,
+                        lambda blk=cells: _dispatch_block(blk))):
+                shield = self._host_decode_block(codec, present, want,
+                                                 kp, su)
+                if self.perf is not None:
+                    self.perf.inc("ec_decode_cold_host")
+                out = self._overdecomposed(cells, shield)
+                return out if out is not None else shield(cells)
+        out = self._overdecomposed(cells, _dispatch_block)
+        return (out if out is not None
+                else _dispatch_block(cells))
 
     def _repair_sync(self, codec, present: tuple, want: tuple,
                      cells: np.ndarray) -> np.ndarray:
@@ -584,19 +805,34 @@ class ECBatcher:
         cells — the regenerating-code sub-chunk repair dispatch
         (padded zero stripes repair to zero cells: all-linear)."""
         engine = getattr(codec, "resolved_backend", lambda: "device")()
-        b = len(cells)
         if engine == "host" or not hasattr(codec, "repair_batch"):
-            host = getattr(codec, "repair_cells_host", None)
-            if host is None:
+            _dispatch_block = self._host_repair_block(codec, present,
+                                                      want)
+            if _dispatch_block is None:
                 raise RuntimeError(
                     f"codec {type(codec).__name__} has no batched "
                     "sub-chunk repair")
-            return host(present, want, cells)
-        from ..ops import rs
+        else:
+            from ..ops import rs
 
-        batch = ECBatcher._pow2_pad(rs.pack_u32(cells))
-        out = codec.repair_batch(present, batch, want)
-        return rs.unpack_u32(np.asarray(out)[:b])
+            def _dispatch_block(blk: np.ndarray) -> np.ndarray:
+                bb = len(blk)
+                batch = ECBatcher._pow2_pad(rs.pack_u32(blk))
+                out = codec.repair_batch(present, batch, want)
+                return rs.unpack_u32(np.asarray(out)[:bb])
+            if (getattr(codec, "repair_cells_host", None) is not None
+                    and self._cold_shape(
+                        ("rep", codec_profile_key(codec),
+                         cells.shape[-1], present, want), cells.nbytes,
+                        lambda blk=cells: _dispatch_block(blk))):
+                shield = self._host_repair_block(codec, present, want)
+                if self.perf is not None:
+                    self.perf.inc("ec_decode_cold_host")
+                out = self._overdecomposed(cells, shield)
+                return out if out is not None else shield(cells)
+        out = self._overdecomposed(cells, _dispatch_block)
+        return (out if out is not None
+                else _dispatch_block(cells))
 
     def _mesh_decode_sync(self, codec, present: tuple, want: tuple,
                           cells: np.ndarray, mesh,

@@ -49,6 +49,8 @@ STORE_SITES = (
     "torn_write",       # persist only a prefix of a shard transaction
     "ec_batch",         # EC batch device dispatch failure
     "op_dispatch_delay",  # stall one client op before it runs
+    "straggle",         # slow-OSD arm: lognormal service-time
+    #                     inflation on shard-serving sub-reads
 )
 
 
@@ -205,6 +207,10 @@ class FaultPlane:
         #: shifts another layer's draws
         self.net = NetFaultPolicy(rng=random.Random(seed ^ 0x9E3779B9))
         self._store_rng = random.Random(seed ^ 0x51ED2705)
+        #: the slow-OSD arm's own stream: straggler delay draws must
+        #: not shift bitrot/torn-write draws (arming stragglers in an
+        #: existing schedule keeps every OTHER layer draw-for-draw)
+        self._straggle_rng = random.Random(seed ^ 0x57A661E5)
         #: site -> (kwargs for FaultInjector.arm)
         self._store_specs: dict[str, dict] = {}
         #: every injector ever attached (revives append; history kept
@@ -221,10 +227,18 @@ class FaultPlane:
         self._injectors.append((osd.id, osd.fault))
         for site, (spec, ids) in self._store_specs.items():
             if ids is None or osd.id in ids:
-                osd.fault.arm(site, rng=self._store_rng, **spec)
+                osd.fault.arm(site, rng=self._rng_for(site), **spec)
+
+    def _rng_for(self, site: str) -> random.Random:
+        """The seeded stream a site's probability/delay draws come
+        from (straggle isolated so the slow-OSD arm never shifts the
+        other store layers' draws)."""
+        return (self._straggle_rng if site == "straggle"
+                else self._store_rng)
 
     def store_fault(self, site: str, count: int = -1, p: float = 1.0,
-                    delay: float = 0.0, osd_ids=None, **match) -> None:
+                    delay: float = 0.0, osd_ids=None,
+                    delay_log: tuple | None = None, **match) -> None:
         """Arm a store/device fault site on every attached OSD (and
         every OSD revived later) — or, with ``osd_ids``, only on that
         subset (the chip-loss arm: a dark mesh device maps to faults
@@ -232,7 +246,8 @@ class FaultPlane:
         plane's seeded store RNG. Re-arming a site REPLACES the prior
         spec on live injectors — stacking arms would make live and
         revived OSDs fire at different rates."""
-        spec = dict(count=count, p=p, delay=delay, **match)
+        spec = dict(count=count, p=p, delay=delay,
+                    delay_log=delay_log, **match)
         ids = None if osd_ids is None else frozenset(osd_ids)
         self._store_specs[site] = (spec, ids)
         seen: set[int] = set()
@@ -242,7 +257,24 @@ class FaultPlane:
             seen.add(osd_id)
             inj.disarm(site)
             if ids is None or osd_id in ids:
-                inj.arm(site, rng=self._store_rng, **spec)
+                inj.arm(site, rng=self._rng_for(site), **spec)
+
+    def slow_osd(self, osd_ids, scale: float = 0.05,
+                 sigma: float = 0.75) -> None:
+        """The persistent slow-OSD arm: seeded lognormal service-time
+        inflation (median ``scale`` seconds, shape ``sigma``) on the
+        victims' shard-serving sub-reads — the straggler, as opposed
+        to the failure, the hedged read fan-outs exist to route
+        around. Re-armed on revive like every store fault (a victim
+        that flaps comes back slow), replaced wholesale on each call:
+        ``slow_osd([])`` heals everyone."""
+        if not osd_ids:
+            self.clear_store_fault("straggle")
+            return
+        import math
+
+        self.store_fault("straggle", p=1.0, osd_ids=osd_ids,
+                         delay_log=(math.log(scale), sigma))
 
     def clear_store_fault(self, site: str) -> None:
         """Disarm ONE site everywhere (the chip-heal verb: the other
@@ -278,9 +310,9 @@ class FaultPlane:
 class ThrashEvent:
     t: float      # seconds from thrash start
     kind: str     # kill | revive | partition | heal | mon_flap
-    #             # | chip_loss | chip_heal
-    target: int = -1  # osd id (kill/revive/partition) or mesh chip
-    #                   (chip_loss/chip_heal); -1 = n/a
+    #             # | chip_loss | chip_heal | straggle | unstraggle
+    target: int = -1  # osd id (kill/revive/partition/straggle) or
+    #                   mesh chip (chip_loss/chip_heal); -1 = n/a
 
 
 def chip_owners(n_osds: int, n_chips: int, chip: int) -> list[int]:
@@ -296,7 +328,8 @@ def build_schedule(seed: int, duration: float, n_osds: int,
                    max_unavail: int = 1, gap: tuple[float, float] =
                    (0.4, 1.2), partitions: bool = True,
                    mon_flaps: bool = False, chip_loss: bool = False,
-                   n_chips: int = 8) -> list[ThrashEvent]:
+                   n_chips: int = 8,
+                   stragglers: int = 0) -> list[ThrashEvent]:
     """Deterministic thrash schedule: a pure function of its arguments
     (same seed => same schedule, the replayability contract). The
     generator tracks the dead/partitioned/dark set so it never
@@ -304,7 +337,15 @@ def build_schedule(seed: int, duration: float, n_osds: int,
     OSDs — an EC pool keeps >= k shards reachable throughout. With
     ``chip_loss``, mesh-chip failures join the mix: a dark chip
     counts every live owning OSD (chip_owners) against the
-    availability budget, exactly like a kill of those daemons."""
+    availability budget, exactly like a kill of those daemons.
+
+    ``stragglers`` > 0 interleaves straggle/unstraggle events from an
+    INDEPENDENT seeded stream (the availability draws above are
+    untouched, so legacy schedules stay draw-for-draw identical): at
+    most ``min(stragglers, max_unavail)`` OSDs are slow at once. A
+    straggling OSD stays up and correct — it just serves slowly
+    (FaultPlane.slow_osd lognormal inflation), which is the tail the
+    hedged read fan-outs exist to cut."""
     rng = random.Random(seed)
     # an all-dead cluster has nothing left to thrash (and nothing to
     # converge back): always keep at least one OSD reachable
@@ -376,6 +417,36 @@ def build_schedule(seed: int, duration: float, n_osds: int,
             dark_owners = set()
         elif kind == "mon_flap":
             events.append(ThrashEvent(round(t, 3), "mon_flap"))
+    if stragglers > 0:
+        # separate stream + separate time walk: straggler scheduling
+        # can never shift the availability draws above (the
+        # draw-for-draw legacy identity contract)
+        srng = random.Random(seed ^ 0x57A66)
+        bound = max(1, min(stragglers, max_unavail))
+        slowed: set[int] = set()
+        sev: list[ThrashEvent] = []
+        st = 0.0
+        while True:
+            st += srng.uniform(1.0, 3.0)
+            if st >= duration:
+                break
+            # bias toward arming while under the bound — a thrash with
+            # no straggler exercising nothing is wasted wall-clock
+            if slowed and (len(slowed) >= bound
+                           or srng.random() >= 0.6):
+                victim = srng.choice(sorted(slowed))
+                slowed.discard(victim)
+                sev.append(ThrashEvent(round(st, 3), "unstraggle",
+                                       victim))
+            else:
+                pool = sorted(set(range(n_osds)) - slowed)
+                if not pool:
+                    continue
+                victim = srng.choice(pool)
+                slowed.add(victim)
+                sev.append(ThrashEvent(round(st, 3), "straggle",
+                                       victim))
+        events = sorted(events + sev, key=lambda e: e.t)
     return events
 
 
@@ -533,7 +604,9 @@ class Thrasher:
                  mon_flaps: bool = False, n_objects: int = 8,
                  obj_size: int = 24 << 10, writers: int = 4,
                  settle_timeout: float = 90.0,
-                 chip_loss: bool = False, n_chips: int = 8):
+                 chip_loss: bool = False, n_chips: int = 8,
+                 stragglers: int = 0, straggle_scale: float = 0.05,
+                 straggle_sigma: float = 0.75):
         self.cluster = cluster
         self.plane: FaultPlane = cluster.faults
         self.pool_id = pool_id
@@ -546,15 +619,21 @@ class Thrasher:
         self.chip_loss = chip_loss
         self.n_chips = n_chips
         self.settle_timeout = settle_timeout
+        self.stragglers = stragglers
+        self.straggle_scale = straggle_scale
+        self.straggle_sigma = straggle_sigma
         self.workload = OracleWorkload(cluster.client, pool_id,
                                        seed=seed, n_objects=n_objects,
                                        size=obj_size, writers=writers)
         self.schedule = build_schedule(
             seed, duration, cluster.n_osds, max_unavail=max_unavail,
             partitions=partitions, mon_flaps=self.mon_flaps,
-            chip_loss=chip_loss, n_chips=n_chips)
+            chip_loss=chip_loss, n_chips=n_chips,
+            stragglers=stragglers)
         self.applied: list[ThrashEvent] = []
         self._dead_mons: list[int] = []
+        self._slowed: set[int] = set()
+        self._slowed_at_heal: list[int] = []
 
     async def _apply(self, ev: ThrashEvent) -> None:
         c = self.cluster
@@ -578,6 +657,19 @@ class Thrasher:
             self.plane.store_fault("ec_batch", p=1.0, osd_ids=owners)
         elif ev.kind == "chip_heal":
             self.plane.clear_store_fault("ec_batch")
+        elif ev.kind == "straggle":
+            # slow, not dead: the OSD keeps serving, just with seeded
+            # lognormal inflation — the persistent-straggler arm the
+            # hedged fan-outs route around
+            self._slowed.add(ev.target)
+            self.plane.slow_osd(sorted(self._slowed),
+                                scale=self.straggle_scale,
+                                sigma=self.straggle_sigma)
+        elif ev.kind == "unstraggle":
+            self._slowed.discard(ev.target)
+            self.plane.slow_osd(sorted(self._slowed),
+                                scale=self.straggle_scale,
+                                sigma=self.straggle_sigma)
         elif ev.kind == "mon_flap":
             # never break the quorum MAJORITY: killed mons stay down
             # until the final heal, and a second flap on a 3-mon
@@ -600,6 +692,10 @@ class Thrasher:
     async def _heal_everything(self) -> None:
         c = self.cluster
         self.plane.net.clear()
+        # snapshot the straggler set for the verdict before the wipe
+        # (clear_store_faults drops the straggle arms with the rest)
+        self._slowed_at_heal = sorted(self._slowed)
+        self._slowed = set()
         self.plane.clear_store_faults()
         for rank in self._dead_mons:
             await c.revive_mon(rank)
@@ -658,6 +754,20 @@ class Thrasher:
         mismatches = await self.workload.verify() if converged else []
         passed = (converged and not inconsistent and not mismatches
                   and not self.workload.read_mismatches)
+        # degraded-tail ledger: sum the hedge counters over the live
+        # daemons (kill/revive drops a dead incarnation's counts — the
+        # ledger reports what the surviving processes actually did).
+        # Leak-free invariant: canceled == fired - won; the straggler
+        # thrash test asserts it on this very dict.
+        hedge = {k: 0 for k in ("ec_hedges_fired", "ec_hedges_won",
+                                "ec_hedges_canceled",
+                                "ec_hedges_wasted_bytes")}
+        for o in c.osds:
+            if o is None:
+                continue
+            d = o.perf.dump()
+            for k in hedge:
+                hedge[k] += int(d.get(k, 0))
         return {
             "seed": self.seed,
             "duration": self.duration,
@@ -673,5 +783,14 @@ class Thrasher:
                                    for o in inconsistent],
             "oracle_mismatches": mismatches,
             "faults_injected": self.plane.injected(),
+            "hedge_counters": hedge,
+            "stragglers": {
+                "requested": self.stragglers,
+                "scheduled": sum(1 for e in self.schedule
+                                 if e.kind == "straggle"),
+                "applied": sum(1 for e in self.applied
+                               if e.kind == "straggle"),
+                "slowed_at_heal": self._slowed_at_heal,
+            },
             "passed": passed,
         }

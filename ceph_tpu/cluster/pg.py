@@ -42,6 +42,7 @@ from ..utils import trace as tr
 from . import messages as M
 from . import snaps as sn
 from . import stripe as st
+from .hedge import hedged_fanout
 from .pglog import (OP_DELETE, OP_MODIFY, ZERO, Entry, PGInfo, PGLog,
                     dec_missing, enc_missing)
 
@@ -763,9 +764,10 @@ class PG:
                               outs=[], epoch=self.osd.osdmap.epoch),
             )
             return
-        # -- write-op dedup (reqid reply-cache role). Reads are
-        # idempotent and skip it; `requeued` re-entries are the PG's own
-        # park-queue drain, not network duplicates.
+        # -- write-op dedup (reqid reply-cache role). Replicated reads
+        # are idempotent single-store hits and skip it; `requeued`
+        # re-entries are the PG's own park-queue drain, not network
+        # duplicates.
         is_write = any(o[0] in WRITE_OPS or o[0] == "call" for o in m.ops)
         if is_write:
             key = (src, m.tid)
@@ -776,6 +778,23 @@ class PG:
             if not requeued:
                 if key in self._req_inflight:
                     return  # duplicate of a parked/executing op
+                self._req_inflight.add(key)
+        elif self.is_ec and m.ops and not (
+                len(m.ops) == 1 and m.ops[0][0] == "pgls"):
+            # hedge/resend seam (the PR-3 incarnation-nonce discipline
+            # extended to hedge tasks): an EC read executes as a hedged
+            # fan-out holding live subtid reply expectations. A client
+            # tick-resend of the SAME (src, tid) arriving mid-hedge
+            # must NOT launch a second concurrent fan-out — the
+            # executing one's reply already carries this tid and serves
+            # both, while a doubled fan-out would double-count hedges
+            # and race two decodes of one op. Reads keep NO reply
+            # cache: the marker drops the moment the reply is sent, so
+            # a LOST reply simply re-executes on the next resend.
+            if not requeued:
+                key = (src, m.tid)
+                if key in self._req_inflight:
+                    return  # duplicate of an executing hedged fan-out
                 self._req_inflight.add(key)
         if self.state != "active":
             self.waiting.append((src, m))
@@ -888,6 +907,12 @@ class PG:
                 self._req_replies[key] = reply
                 while len(self._req_replies) > 512:
                     self._req_replies.popitem(last=False)
+        elif self.is_ec:
+            # EC-read marker (hedge/resend seam in do_op): dropped as
+            # the reply goes out — no reply cache for reads, so a lost
+            # reply re-executes on the client's next resend instead of
+            # serving a stale cached payload
+            self._req_inflight.discard((src, m.tid))
         await self.osd.send(src, reply)
 
     # ------------------------------------------------- op-vector engine
@@ -1796,6 +1821,42 @@ class PG:
                 found = (reply.size, reply.attrs)
         return found
 
+    def _hedge_extra(self) -> int:
+        """Hedge width: extra candidates a fan-out may launch beyond
+        the minimal plan (0 when hedging is off — plan-exact)."""
+        if not self.osd.hedge_enabled():
+            return 0
+        try:
+            return int(self.osd.conf["osd_hedge_max_extra"])
+        except Exception:
+            return 2
+
+    def _mk_subread(self, j: int, target: int, oid: bytes,
+                    coff: int, clen: int):
+        """Candidate factory for one remote EC sub-read: expects the
+        reply under a fresh sub-tid and cleans the expectation up on
+        ANY exit — cancellation included, so a hedged loser leaves no
+        pending future behind (a late reply to a dropped key is a
+        no-op in OSD._resolve)."""
+        osd = self.osd
+
+        async def _one():
+            subtid = osd.new_subtid()
+            fut = osd.expect_reply(subtid)
+            try:
+                await osd.send(
+                    f"osd.{target}",
+                    M.MECSubRead(tid=subtid, pgid=self.pgid, shard=j,
+                                 oid=oid, offset=coff, length=clen,
+                                 trace=_trace_ctx()),
+                )
+                return await osd.await_reply(subtid, fut, target)
+            except BaseException:
+                osd.drop_reply(subtid)
+                raise
+
+        return _one
+
     async def _read_ec(self, oid: bytes, offset: int = 0,
                        length: int = -1) -> tuple[bytes, int]:
         """Bytes of [offset, offset+length) (clamped to the object) and
@@ -1841,6 +1902,15 @@ class PG:
         vers: dict[int, tuple[int, int]] = {}
         sizes: dict[int, int] = {}
         failed: set[int] = set()
+        #: hedge results from shards OUTSIDE the minimal plan —
+        #: (data, ver, size) kept aside so the next re-plan consumes
+        #: them for free instead of re-fetching (chunks itself stays
+        #: plan-members-only: all-row codecs decode exactly the plan)
+        spare: dict[int, tuple] = {}
+        #: shards whose fetch a hedge out-raced (cancelled losers):
+        #: slow-not-dead — deprioritized from later plans, never
+        #: excluded outright (planning relaxes when it would starve)
+        slow: set[int] = set()
         enoent = 0
         for _replan in range(4):
             if size is not None:
@@ -1868,10 +1938,20 @@ class PG:
                 s0, s1 = 0, 0
                 coff, clen = 0, -1
             while True:
-                usable = [s for s in sorted(live) if s not in failed]
+                usable = [s for s in sorted(live)
+                          if s not in failed
+                          and (s not in slow or s in chunks
+                               or s in spare)]
                 try:
                     need = codec.minimum_to_decode(want, usable)
                 except Exception:
+                    if slow and not all(
+                            s in chunks or s in spare for s in slow):
+                        # deprioritizing the hedge-cancelled
+                        # stragglers starved the plan: rejoin them
+                        # (the fan-out below awaits them in full)
+                        slow.clear()
+                        continue
                     # not enough non-demoted shards left: fall back to
                     # the newest generation with >= k fetched members
                     fb = _best_version_group({**demoted, **chunks},
@@ -1885,10 +1965,18 @@ class PG:
                         f"cannot reconstruct {oid!r}: shards "
                         f"{sorted(failed)} unreadable"
                     )
-                waits = []
-                sends = []
+                primary = []
                 for j in sorted(need):
                     if j in chunks:
+                        continue
+                    if j in spare:
+                        # a hedge already fetched this shard: consume
+                        data, ver, sz = spare.pop(j)
+                        chunks[j] = data
+                        vers[j] = ver
+                        sizes[j] = sz
+                        if size is None:
+                            size = sz
                         continue
                     target = live[j]
                     if target == self.osd.id:
@@ -1929,38 +2017,83 @@ class PG:
                         except IOError:
                             failed.add(j)
                         continue
-                    subtid = osd.new_subtid()
-                    fut = osd.expect_reply(subtid)
-                    waits.append((j, target, subtid, fut))
-                    sends.append(osd.send(
-                        f"osd.{target}",
-                        M.MECSubRead(tid=subtid, pgid=self.pgid, shard=j,
-                                     oid=oid, offset=coff, length=clen,
-                                     trace=_trace_ctx()),
-                    ))
-                if sends:
+                    primary.append((j, target,
+                                    self._mk_subread(j, target, oid,
+                                                     coff, clen)))
+                # hedge candidates: usable shards OUTSIDE the plan
+                # (d > k fan-out), fastest EWMA peers first — launched
+                # by hedged_fanout only if the plan drags past the
+                # per-peer hedge delay
+                extras = []
+                if primary:
+                    cand = sorted(
+                        (s for s in usable
+                         if s not in need and s not in chunks
+                         and s not in spare
+                         and live[s] != self.osd.id),
+                        key=lambda s: (osd.peer_ewma.latency(live[s]),
+                                       s))
+                    extras = [
+                        (s, live[s],
+                         self._mk_subread(s, live[s], oid, coff, clen))
+                        for s in cand[: self._hedge_extra()]]
+
+                def _suff(out: dict) -> bool:
+                    # first decodable subset: what we hold + what the
+                    # fan-out returned OK plans a decode for `want`
+                    have = set(chunks) | set(spare) | {
+                        j for j, r in out.items()
+                        if not isinstance(r, BaseException)
+                        and r.result == M.OK}
                     try:
-                        await asyncio.gather(*sends)
-                    except BaseException:
-                        for _j, _t, subtid, _f in waits:
-                            osd.drop_reply(subtid)
-                        raise
-                for j, target, subtid, fut in waits:
-                    reply = await osd.await_reply(subtid, fut, target)
-                    if reply.result == M.OK:
-                        chunks[j] = reply.data
-                        vers[j] = tuple(reply.ver)
-                        sizes[j] = reply.size
+                        plan = codec.minimum_to_decode(want,
+                                                       sorted(have))
+                    except Exception:
+                        return False
+                    return all(p in have for p in plan)
+
+                def _nbytes(r) -> int:
+                    return (len(r.data)
+                            if not isinstance(r, BaseException)
+                            and r.result == M.OK and r.data else 0)
+
+                out = {}
+                if primary:
+                    out = await hedged_fanout(osd, primary, extras,
+                                              _suff, nbytes=_nbytes)
+                exc = None
+                for j in sorted(out):
+                    r = out[j]
+                    if isinstance(r, BaseException):
+                        # transport failure: transient, triaged below
+                        exc = exc if exc is not None else r
+                        continue
+                    if r.result == M.OK:
+                        if j in need and j not in chunks:
+                            chunks[j] = r.data
+                            vers[j] = tuple(r.ver)
+                            sizes[j] = r.size
+                        else:
+                            spare[j] = (r.data, tuple(r.ver), r.size)
                         if size is None:
-                            size = reply.size
+                            size = r.size
                     else:
-                        if reply.result == M.ENOENT:
+                        if r.result == M.ENOENT:
                             enoent += 1
-                        elif reply.result == M.EIO:
+                        elif r.result == M.EIO:
                             # shard-side hinfo/IO failure: repair it
                             self._kick_read_repair(oid, j, live)
                         failed.add(j)
                 if not all(j in chunks for j in need):
+                    # plan members absent from the outcome map were
+                    # hedge-cancelled losers: slow, not dead
+                    slow.update(j for j in need
+                                if j not in chunks and j not in failed
+                                and j not in out)
+                    if exc is not None and not _suff(out):
+                        # a transport failure AND no decodable subset:
+                        # keep the legacy transient-abort contract
+                        raise exc
                     continue
                 if self._demote_version_laggards(chunks, vers, demoted,
                                                  failed):
@@ -2000,6 +2133,7 @@ class PG:
                 # just chose THEIR generation, leaving them in
                 # ``failed`` would strand the only decodable copy
                 chunks.clear()
+                spare.clear()  # fetched at the stale (narrower) range
                 failed.difference_update(demoted)
                 demoted.clear()
                 vers.clear()
@@ -2378,6 +2512,12 @@ class PG:
         — but only the selected sub-chunk slices of each cell go on
         the wire (the repair-traffic reduction the sub-chunk plan
         exists for)."""
+        # slow-OSD arm (FaultPlane.slow_osd): lognormal service-time
+        # inflation on the shard-serving path — the straggler the
+        # hedged read fan-outs route around. No PG lock is held here
+        # (shard-side serving), so the stall slows this sub-read only.
+        await self.osd.fault.pause("straggle", osd=self.osd.id,
+                                   shard=m.shard)
         try:
             if self.osd.fault.hit("ec_sub_read", oid=m.oid,
                                   osd=self.osd.id, shard=m.shard):
@@ -3195,17 +3335,57 @@ class PG:
         size_attrs: dict[int, bytes] = {}
         attrs_by: dict[int, dict[str, bytes]] = {}
         chunks: dict[int, bytes] = {}
-        got = await asyncio.gather(
-            *(self._fetch_shard_copy(oid, j, live, vers, size_attrs,
-                                     attrs_by, subruns=packed)
-              for j in sorted(need)),
-            return_exceptions=True)
-        for j, data in zip(sorted(need), got):
-            if isinstance(data, BaseException) or data is None:
-                # transient or unreadable either way: the full path
-                # re-plans with its own retry/fallback machinery
+
+        def _mk(j: int):
+            return lambda: self._fetch_shard_copy(
+                oid, j, live, vers, size_attrs, attrs_by,
+                subruns=packed)
+
+        d = len(need)
+        helpers = sorted(need)
+        # hedge candidates: helpers beyond the d-of-n plan ship the
+        # SAME repair-plane sub-runs; the first d consistent arrivals
+        # rebuild the shard and the stragglers are cancelled
+        cand = sorted((s for s in usable
+                       if s not in need and s != shard),
+                      key=lambda s: (
+                          self.osd.peer_ewma.latency(live[s])
+                          if live.get(s) != self.osd.id else -1.0, s))
+        extras = [(s, live[s], _mk(s))
+                  for s in cand[: self._hedge_extra()]]
+
+        def _suff(out: dict) -> bool:
+            return sum(1 for r in out.values()
+                       if r is not None
+                       and not isinstance(r, BaseException)) >= d
+
+        out = await hedged_fanout(
+            self.osd, [(j, live[j], _mk(j)) for j in helpers],
+            extras, _suff,
+            nbytes=lambda r: (len(r) if isinstance(
+                r, (bytes, bytearray, memoryview)) else 0))
+        ok = sorted(j for j, r in out.items()
+                    if r is not None
+                    and not isinstance(r, BaseException))
+        if len(ok) < d:
+            # helper failure/transient either way: the full path
+            # re-plans with its own retry/fallback machinery
+            return None
+        chosen = ok[:d]
+        if chosen != helpers:
+            # hedge substitution: re-derive the repair plan over the
+            # ACTUAL helper set and demand the same sub-run layout —
+            # any disagreement (helper-set-dependent planes) falls
+            # back to the hardened full path
+            try:
+                need2 = codec.minimum_to_decode([shard], chosen)
+            except Exception:
                 return None
-            chunks[j] = data
+            if (shard in need2 or sorted(need2) != chosen
+                    or any(r != runs for r in need2.values())):
+                return None
+        for j in chosen:
+            chunks[j] = out[j]
         # one consistent generation or bust: the full path owns every
         # version-skew story (fallback groups, strays, demotions)
         gens = {vers.get(j, ZERO) for j in chunks}
@@ -3301,13 +3481,16 @@ class PG:
                              subruns=subruns, trace=_trace_ctx()),
             )
             reply = await self.osd.await_reply(subtid, fut, target)
-        except Exception:
+        except BaseException:
             # transport failure (peer flapping, send raced a kill) is
             # TRANSIENT: re-raise after cleanup so callers retry the
             # round — swallowing it here would make the shard look
             # unreadable and let recovery misclassify a reachable
             # object as unfound debris (and converge log heads over
-            # the gap: acked-write loss)
+            # the gap: acked-write loss). BaseException, not
+            # Exception: a hedged fan-out cancels losers, and a
+            # CancelledError slipping past would leak the pending
+            # reply expectation.
             self.osd.drop_reply(subtid)
             raise
         if reply.result != M.OK:
@@ -3388,13 +3571,22 @@ class PG:
         size_attrs: dict[int, bytes] = {}
         attrs_by: dict[int, dict[str, bytes]] = {}
         failed: set[int] = {shard}
+        #: hedge results from shards outside the plan (see _read_ec)
+        spare: dict[int, bytes] = {}
+        slow: set[int] = set()
         tried_self = False
         tried_strays = False
         while True:
-            usable = [s for s in sorted(live) if s not in failed]
+            usable = [s for s in sorted(live)
+                      if s not in failed
+                      and (s not in slow or s in chunks or s in spare)]
             try:
                 need = codec.minimum_to_decode([shard], usable)
             except Exception:
+                if slow and not all(
+                        s in chunks or s in spare for s in slow):
+                    slow.clear()  # stragglers rejoin: see _read_ec
+                    continue
                 # newest generation can't reach k members (interrupted
                 # fan-out): rebuild the newest generation that can —
                 # see _best_version_group; the retry re-applies the
@@ -3443,16 +3635,68 @@ class PG:
                     f"cannot reconstruct shard {shard} of {oid!r}: "
                     f"unreadable {sorted(failed - {shard})}"
                 )
+            def _mk(j: int):
+                return lambda: self._fetch_shard_copy(
+                    oid, j, live, vers, size_attrs, attrs_by)
+
+            primary = []
             for j in sorted(need):
                 if j in chunks:
                     continue
-                got = await self._fetch_shard_copy(
-                    oid, j, live, vers, size_attrs, attrs_by)
-                if got is None:
+                if j in spare:
+                    chunks[j] = spare.pop(j)
+                    continue
+                primary.append((j, live[j], _mk(j)))
+            extras = []
+            if primary:
+                cand = sorted(
+                    (s for s in usable
+                     if s not in need and s not in chunks
+                     and s not in spare),
+                    key=lambda s: (
+                        self.osd.peer_ewma.latency(live[s]), s))
+                extras = [(s, live[s], _mk(s))
+                          for s in cand[: self._hedge_extra()]]
+
+            def _suff(out: dict) -> bool:
+                have = set(chunks) | set(spare) | {
+                    j for j, r in out.items()
+                    if r is not None
+                    and not isinstance(r, BaseException)}
+                try:
+                    plan = codec.minimum_to_decode([shard],
+                                                   sorted(have))
+                except Exception:
+                    return False
+                return all(p in have for p in plan)
+
+            out = {}
+            if primary:
+                out = await hedged_fanout(
+                    self.osd, primary, extras, _suff,
+                    nbytes=lambda r: (len(r) if isinstance(
+                        r, (bytes, bytearray, memoryview)) else 0))
+            exc = None
+            for j in sorted(out):
+                r = out[j]
+                if isinstance(r, BaseException):
+                    exc = exc if exc is not None else r
+                elif r is None:
                     failed.add(j)
+                elif j in need and j not in chunks:
+                    chunks[j] = r
                 else:
-                    chunks[j] = got
+                    spare[j] = r
             if not all(j in chunks for j in need):
+                # absent-from-outcomes plan members were hedge-
+                # cancelled losers (slow, not dead); a transport
+                # failure with NO decodable subset keeps the legacy
+                # transient contract — re-raise so the caller retries
+                slow.update(j for j in need
+                            if j not in chunks and j not in failed
+                            and j not in out)
+                if exc is not None and not _suff(out):
+                    raise exc
                 continue  # re-plan with the enlarged failed set
             if self._demote_version_laggards(chunks, vers, demoted,
                                              failed):

@@ -158,6 +158,19 @@ def gf2_apply_np(plan: np.ndarray, rows: np.ndarray) -> np.ndarray:
     return np.bitwise_xor.reduce(np.take(ext, plan, axis=-2), axis=-2)
 
 
+def gf2_apply_np_blocked(plan: np.ndarray, rows: np.ndarray,
+                         block: int = 256) -> np.ndarray:
+    """Batch-blocked host apply: byte-identical to ``gf2_apply_np``,
+    but the (..., R, terms, L) gather intermediate is materialized at
+    most ``block`` stripes at a time — a recovery storm's host decode
+    keeps bounded scratch instead of scaling it with the batch, and
+    the over-decomposed dispatch's row blocks reuse the same grain."""
+    if rows.ndim < 3 or len(rows) <= block:
+        return gf2_apply_np(plan, rows)
+    return np.concatenate([gf2_apply_np(plan, rows[i:i + block])
+                           for i in range(0, len(rows), block)])
+
+
 def gf2_encode_cells_np(plan: np.ndarray, w: int,
                         cells: np.ndarray) -> np.ndarray:
     """Host cell-level entry: cells (..., k, su) uint8 -> coding
@@ -165,5 +178,5 @@ def gf2_encode_cells_np(plan: np.ndarray, w: int,
     lead = cells.shape[:-2]
     c, su = cells.shape[-2], cells.shape[-1]
     rows = cells.reshape(*lead, c * w, su // w)
-    out = gf2_apply_np(plan, rows)
+    out = gf2_apply_np_blocked(plan, rows)
     return out.reshape(*lead, plan.shape[0] // w, su)

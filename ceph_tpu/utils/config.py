@@ -229,6 +229,41 @@ SCHEMA = Schema([
                 "matmul with recovery partials combined by mesh "
                 "collectives instead of messenger fan-in (needs "
                 "osd_ec_mesh_devices > 1)"),
+    Option("osd_hedge_reads", "bool", True,
+           desc="straggler-proof EC read dispatch: degraded reads and "
+                "shard reconstructs fan sub-reads out to d > k "
+                "candidates, complete on the first decodable subset "
+                "and cancel the losers (first-sufficient-subset "
+                "hedging); the CEPH_TPU_HEDGE=0 env lever forces it "
+                "off for A/B runs"),
+    Option("osd_hedge_delay_factor", "float", 2.0, min=1.0,
+           desc="hedge trigger multiplier over the per-peer sub-op "
+                "latency EWMA: extra candidates launch after factor x "
+                "the upper-median EWMA of the planned peers (median, "
+                "so one known straggler cannot postpone the hedge "
+                "aimed at it), clamped to the client_backoff_base/"
+                "client_backoff_max bounded-backoff shape"),
+    Option("osd_hedge_max_extra", "int", 2, min=0,
+           desc="hedge width: extra shard candidates (beyond the "
+                "minimal decode plan) a single fan-out may launch "
+                "(0 = plan-exact fan-out, hedging off)"),
+    Option("osd_ec_overdecompose", "int", 0, min=0,
+           desc="recovery-matmul over-decomposition factor: >0 splits "
+                "each batched decode/repair dispatch into factor x "
+                "workers row-block sub-tasks dispatched redundantly, "
+                "first result per block wins — a slow worker sheds "
+                "its block instead of gating the round (rateless "
+                "over-decomposition stance; 0 = one dispatch per "
+                "batch, the legacy path)"),
+    Option("osd_ec_cold_shape_bytes", "size", 256 << 20, min=0,
+           desc="cold-shape shield threshold: a decode/repair survivor "
+                "pattern dispatches on the host engine until its "
+                "cumulative bytes cross this volume, then promotes to "
+                "the device engine where the fresh-shape kernel "
+                "compile amortizes — storm patterns promote within a "
+                "few stacked rounds, the one-off patterns hedged "
+                "reads manufacture stay host and never stall a waiting "
+                "read on a compile (0 disables the shield)"),
     Option("osd_ec_verify_on_read", "bool", True,
            desc="verify per-cell hinfo CRC32C on EVERY EC read, normal "
                 "or degraded: a mismatch excludes the shard (EIO, "

@@ -37,6 +37,11 @@ class _Arm:
     p: float = 1.0  # firing probability per eligible hit
     rng: random.Random | None = None
     delay: float = 0.0  # seconds pause() sleeps when this arm fires
+    #: (mu, sigma) of a lognormal delay drawn per fire from ``rng``
+    #: (the slow-OSD service-time inflation arm: deterministic under a
+    #: seeded rng, heavy-tailed like real storage stragglers); takes
+    #: precedence over the fixed ``delay``
+    delay_log: tuple | None = None
 
 
 class FaultInjector:
@@ -49,14 +54,17 @@ class FaultInjector:
 
     def arm(self, site: str, count: int = -1, p: float = 1.0,
             rng: random.Random | None = None, delay: float = 0.0,
-            **match) -> None:
+            delay_log: tuple | None = None, **match) -> None:
         """Arm `site` to fire `count` times (-1 = forever) when every
         key in `match` equals the corresponding hit() attribute; with
         ``p`` < 1 each eligible hit fires with that probability, drawn
-        from ``rng`` (pass a seeded one for deterministic replay)."""
+        from ``rng`` (pass a seeded one for deterministic replay).
+        ``delay_log=(mu, sigma)`` makes pause() draw a lognormal sleep
+        per fire instead of the fixed ``delay``."""
         with self._lock:
             self._arms.setdefault(site, []).append(
-                _Arm(count, match, p=p, rng=rng, delay=delay))
+                _Arm(count, match, p=p, rng=rng, delay=delay,
+                     delay_log=delay_log))
 
     def disarm(self, site: str) -> None:
         with self._lock:
@@ -105,7 +113,11 @@ class FaultInjector:
             return False
         if self.on_fire is not None:
             self.on_fire(site)
-        if arm.delay > 0:
+        if arm.delay_log is not None:
+            mu, sigma = arm.delay_log
+            await asyncio.sleep(
+                (arm.rng or random).lognormvariate(mu, sigma))
+        elif arm.delay > 0:
             await asyncio.sleep(arm.delay)
         return True
 

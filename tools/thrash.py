@@ -75,6 +75,11 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--mesh-width", type=int, default=2,
                     help="mesh width axis for --chip-loss (must "
                          "divide --chips; default %(default)s)")
+    ap.add_argument("--stragglers", type=int, default=0,
+                    help="straggle/unstraggle events keeping up to N "
+                         "OSDs persistently slow (seeded lognormal "
+                         "service-time inflation; the hedged-read "
+                         "arm of the fault mix — default off)")
     ap.add_argument("--no-partitions", action="store_true")
     ap.add_argument("--objects", type=int, default=8)
     ap.add_argument("--obj-size", type=int, default=24 << 10)
@@ -106,7 +111,8 @@ def main(argv: list[str] | None = None) -> int:
                                partitions=not args.no_partitions,
                                mon_flaps=args.mons > 1,
                                chip_loss=args.chip_loss,
-                               n_chips=args.chips)
+                               n_chips=args.chips,
+                               stragglers=args.stragglers)
         print(json.dumps({"seed": args.seed,
                           "events": [[e.t, e.kind, e.target]
                                      for e in sched]}, indent=1))
@@ -189,7 +195,8 @@ async def _run(args, max_unavail: int) -> dict:
         partitions=not args.no_partitions, mon_flaps=args.mons > 1,
         n_objects=args.objects, obj_size=args.obj_size,
         writers=args.writers, settle_timeout=args.settle,
-        chip_loss=args.chip_loss, n_chips=args.chips)
+        chip_loss=args.chip_loss, n_chips=args.chips,
+        stragglers=args.stragglers)
     try:
         verdict = await thrasher.run()
         verdict["health"] = c.mon.health()
