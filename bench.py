@@ -1406,6 +1406,95 @@ def config10_swarm(_latency: float) -> dict:
     return out
 
 
+def config11_fabric_ab(_latency: float) -> dict:
+    """Fabric A/B grid (ISSUE 20 tentpole): config-6 + config-10
+    shapes (4 MiB EC stripes, 4 KiB PUT/GET) offered by N reactor
+    PROCESSES at N in {1,2,4,8}, against three topologies — ``local``
+    (each worker owns a private in-process cluster: the sharding
+    upper bound), ``tcp`` (shared ProcCluster of real daemon
+    processes over TcpMessenger), ``shm`` (same daemons over the
+    shared-memory ring messenger).  Total offered clients stay FIXED
+    across N so the sweep measures reactor capacity, not admission.
+    Per cell: write MiB/s, GET p99 (merged histograms, never averaged
+    percentiles), and cpu-seconds-per-MiB with the daemon and worker
+    halves ledgered separately.  ``host_cpus`` is recorded because
+    scaling curves only mean something relative to the cores the
+    host actually has: on a 1-core container every arm is
+    time-sliced, so N>1 measures fabric overhead, not speedup."""
+    import asyncio
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "ceph_tpu_swarm", os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "tools", "swarm.py"))
+    swarm = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(swarm)
+
+    mix = {"put4m": 0.25, "put4k": 0.35, "get4k": 0.40}
+    total_clients = 240
+    sweep = (1, 2, 4, 8)
+    backends = ("local", "tcp", "shm")
+    cells: dict = {}
+    ok = True
+    for backend in backends:
+        cells[backend] = {}
+        for n in sweep:
+            _progress(f"fabric {backend} x{n} ...")
+            try:
+                r = asyncio.run(swarm.run_fabric(
+                    backend=backend, n_workers=n,
+                    clients_per_worker=max(1, total_clients // n),
+                    duration=2.5, seed=20, n_osds=6, window=512,
+                    depth=6, n_objects=50_000, mix=mix))
+            except Exception as e:  # a dead cell must not kill the grid
+                cells[backend][str(n)] = {"error": repr(e)[:300]}
+                ok = False
+                continue
+            cells[backend][str(n)] = {
+                "write_mib_s": r["write_mib_s"],
+                "mib_s": r["mib_s"],
+                "ops_s": r["ops_s"],
+                "get_p99_ms": r["get_p99_ms"],
+                "cpu_s_per_mib": r["cpu_s_per_mib"],
+                "cpu_s_workers": r["cpu_s_workers"],
+                "cpu_s_daemons": r["cpu_s_daemons"],
+                "op_errors": r["op_errors"],
+                "shapes": {s: {k: v[k] for k in
+                               ("ops", "mib_s", "p50_ms", "p99_ms",
+                                "p999_ms")}
+                           for s, v in r["shapes"].items()},
+            }
+            ok = ok and r["ops"] > 0 and not r["op_errors"]
+
+    def _scale(backend: str, n: int) -> float | None:
+        a = cells[backend].get("1", {}).get("write_mib_s")
+        b = cells[backend].get(str(n), {}).get("write_mib_s")
+        if not a or b is None:
+            return None
+        return round(b / a, 2)
+
+    scaling = {b: {f"n{n}_vs_n1": _scale(b, n) for n in (2, 4, 8)}
+               for b in backends}
+    best = max(
+        (c.get("write_mib_s", 0.0)
+         for by_n in cells.values() for c in by_n.values()), default=0.0)
+    meets_scaling_target = any(
+        (s := _scale(b, 4)) is not None and s > 2.0 for b in backends)
+    return {
+        "ok": ok,
+        "host_cpus": os.cpu_count(),
+        "mix": mix,
+        "total_clients": total_clients,
+        "duration_per_cell_s": 2.5,
+        "single_reactor_baseline_mib_s": 130.6,  # PR 10, config 6
+        "best_write_mib_s": best,
+        "meets_scaling_target_n4_gt_2x": bool(meets_scaling_target),
+        "scaling": scaling,
+        "cells": cells,
+    }
+
+
 def main() -> None:
     _progress("measuring tunnel latency ...")
     latency = measure_latency()
@@ -1422,6 +1511,7 @@ def main() -> None:
         ("8_multichip_ec_k8m3_4MiB", config8_multichip),
         ("9_recovery_storm_per_codec", config9_recovery_storm),
         ("10_swarm_million_object", config10_swarm),
+        ("11_fabric_ab", config11_fabric_ab),
     ):
         _progress(f"{name} ...")
         result["configs"][name] = fn(latency)

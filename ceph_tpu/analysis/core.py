@@ -103,8 +103,8 @@ def preload() -> None:
     """Import the built-in rule modules (registration is import-time,
     the mon/osd "plugins preload" stance)."""
     from . import (rules_buffer, rules_dispatch,  # noqa: F401
-                   rules_dtype, rules_hedge, rules_lock, rules_mesh,
-                   rules_pipeline, rules_trace, rules_wire)
+                   rules_dtype, rules_fabric, rules_hedge, rules_lock,
+                   rules_mesh, rules_pipeline, rules_trace, rules_wire)
 
 
 # ------------------------------------------------------------ AST helpers

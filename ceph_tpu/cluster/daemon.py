@@ -79,7 +79,8 @@ async def _amain(args) -> None:
     from .. import store as store_mod
 
     keys = load_keyring(args.book)
-    bus = NetBus(args.book, keys=keys, secure=args.secure)
+    bus = NetBus(args.book, keys=keys, secure=args.secure,
+                 backend=args.msg_backend)
     await bus.start()
 
     stop_ev = asyncio.Event()
@@ -110,6 +111,12 @@ async def _amain(args) -> None:
         from .osd import OSDLite
 
         conf = cfg.proxy()
+        if args.conf:
+            # launcher-provided overrides (the vstart.sh `-o key=val`
+            # role over process boundaries): the fabric bench needs the
+            # EC coalescing / op-concurrency knobs on REAL daemons
+            conf.apply({k: v for k, v in
+                        (kv.split("=", 1) for kv in args.conf)})
         store_kw = {}
         if args.objectstore != "memstore":
             # store-side group commit rides the daemon config (the
@@ -190,6 +197,15 @@ def main(argv=None) -> None:
     ap.add_argument("--objectstore", default="walstore")
     ap.add_argument("--secure", action="store_true",
                     help="AES-GCM on-wire (needs a keyring)")
+    ap.add_argument("--msg-backend", default="tcp",
+                    choices=["tcp", "shm"],
+                    help="inter-process transport: tcp (CRC-framed "
+                         "sockets) or shm (shared-memory rings with "
+                         "unix-socket doorbells — same-host only)")
+    ap.add_argument("--conf", action="append", default=[],
+                    metavar="KEY=VAL",
+                    help="config override applied before the daemon "
+                         "boots (repeatable; the vstart -o role)")
     ap.add_argument("--platform", default="cpu",
                     choices=["cpu", "default"],
                     help="jax platform: cpu (pinned, the dev-cluster "

@@ -542,6 +542,31 @@ class OSDLite:
             "raw store write bench: {count, size<=4MiB} "
             "(`ceph tell osd.N bench` role, OSD.cc:3302)",
         )
+        async def _scrub_all(a: dict) -> dict:
+            # deep-scrub every PG this daemon is primary for (the
+            # `ceph pg deep-scrub` surface over the asok — the
+            # process-tier thrash verdict needs it without reaching
+            # into daemon memory the way vstart.scrub_pg does)
+            out: dict[str, dict] = {}
+            for pg in list(self.pgs.values()):
+                if not pg.is_primary() or pg.state != "active":
+                    continue
+                rep = await pg.scrub()
+                out[pg.cid] = {
+                    "clean": rep["clean"],
+                    "inconsistent": [
+                        o.hex() if isinstance(o, (bytes, bytearray))
+                        else o for o in rep["inconsistent"]],
+                    "repaired": len(rep["repaired"]),
+                }
+            return out
+
+        sock.register(
+            "scrub",
+            _scrub_all,
+            "deep-scrub all primary PGs; per-PG "
+            "{clean, inconsistent, repaired}",
+        )
         sock.register(
             "dump_tracing",
             lambda a: self.tracer.dump(

@@ -1,16 +1,23 @@
-"""Messengers: in-process LocalBus and asyncio TcpMessenger.
+"""Messengers: in-process LocalBus, asyncio TcpMessenger, and (in
+shmring.py, behind the same seam) the shared-memory ring backend.
 
-Both speak the same CRC-framed wire format (frames.py) and the same
+All three speak the same CRC-framed wire format (frames.py) and the same
 envelope: payload = enc_str(src_entity) + msg bytes, frame.type = message
 type. Entities are reference-style names ("mon", "osd.3", "client.7").
+NetBus (netbus.py) picks the transport per peer pair — the reference's
+pluggable NetworkStack stance (posix/RDMA/DPDK): backend selection is a
+deployment knob, never a protocol change, and every backend consults the
+same seeded NetFaultPolicy ``plan()`` stream sender-side so thrash
+schedules replay identically across transports.
 
 Design stance (vs src/msg/async/AsyncMessenger.h:74): one asyncio reactor
 per process instead of N event-loop threads + a lock hierarchy — the
 Crimson shared-nothing position (src/crimson/). Delivery per peer pair is
 in-order; the bus/TCP stream guarantees it the same way a lossless
-msgr2 connection does. Failed sends surface to the caller — like the
-reference's lossy client policy, retry/resend is an upper-layer concern
-(Objecter resends on map change; mon marks unreachable OSDs down).
+msgr2 connection does (the shm ring is SPSC, so slot order is delivery
+order). Failed sends surface to the caller — like the reference's lossy
+client policy, retry/resend is an upper-layer concern (Objecter resends
+on map change; mon marks unreachable OSDs down).
 """
 from __future__ import annotations
 

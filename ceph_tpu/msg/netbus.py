@@ -25,6 +25,14 @@ kill -9 of a process leaves its book entries behind; senders then get
 connection-refused -> SendError, indistinguishable from a LocalBus
 send to a dead entity — which is the behavior the cluster layer is
 built against.
+
+``backend="shm"`` swaps same-host transport for the shared-memory
+ring messenger (msg/shmring.py): payload segments are gathered once
+into a shared arena instead of flattening into a kernel socket, with
+a unix-socket doorbell per burst. The book entry then reads
+``shm <sock> <host> <port>`` so tcp-backend peers still interoperate
+via the host/port half. Entity-envelope signatures (_env_sig) are
+backend-independent.
 """
 from __future__ import annotations
 
@@ -52,10 +60,13 @@ def _env_sig(key: bytes, src: str, dst: str, mtype: int,
 
 class NetBus:
     def __init__(self, book_dir: str, keys=None, secure: bool = False,
-                 host: str = "127.0.0.1"):
+                 host: str = "127.0.0.1", backend: str = "tcp"):
+        if backend not in ("tcp", "shm"):
+            raise ValueError(f"unknown NetBus backend {backend!r}")
         self.book_dir = book_dir
         os.makedirs(book_dir, exist_ok=True)
         self.host = host
+        self.backend = backend
         self.entities: dict[str, Dispatcher] = {}
         #: LocalBus test-hook parity; process-level tests use signals
         #: instead, so this only gates outgoing sends
@@ -69,22 +80,42 @@ class NetBus:
         self._keys = keys
         self._tcp = TcpMessenger(self._node, self._dispatch, keys=keys,
                                  secure=secure)
+        # backend="shm": same-host peers ride the shared-memory ring
+        # messenger (msg/shmring.py); TCP stays up as the interop
+        # fallback (a tcp-backend peer resolves our book entry to its
+        # host/port half), and the per-entity envelope signatures keep
+        # working unchanged — shm skips only the LINK-level cephx
+        # handshake, which guards a byte stream that no longer exists.
+        self._shm = None
+        if backend == "shm":
+            from .shmring import ShmMessenger
+
+            self._shm = ShmMessenger(self._node, self._dispatch)
         self._addr: tuple[str, int] | None = None
+        self._shm_path: str | None = None
         self._tasks: set[asyncio.Task] = set()
-        #: entity -> (host, port) resolution cache, invalidated on
-        #: send failure (peers re-listen on new ports after restart)
-        self._cache: dict[str, tuple[str, int]] = {}
+        #: entity -> parsed book address, invalidated on send failure
+        #: (peers re-listen on new ports/sockets after restart)
+        self._cache: dict[str, tuple] = {}
 
     # ----------------------------------------------------------- lifecycle
 
     async def start(self) -> None:
         if self._addr is None:
             self._addr = await self._tcp.listen(self.host, 0)
+        if self._shm is not None and self._shm_path is None:
+            # short path outside the book dir: AF_UNIX paths cap at
+            # ~108 bytes and pytest tmp book dirs routinely blow that
+            self._shm_path = await self._shm.listen(
+                f"/tmp/ctpu-shm-{os.getpid()}-{id(self) & 0xFFFFFF:x}"
+                ".sock")
 
     async def close(self) -> None:
         for name in list(self.entities):
             self.unregister(name)
         await self._tcp.close()
+        if self._shm is not None:
+            await self._shm.close()
         for t in list(self._tasks):
             t.cancel()
 
@@ -94,11 +125,21 @@ class NetBus:
         # entity names are shell-safe ("osd.3", "client.0", "mon")
         return os.path.join(self.book_dir, name)
 
-    def _publish(self, name: str) -> None:
+    def _book_entry(self) -> str:
+        """This bus's published address line. TCP backend: ``host
+        port`` (the historical form). shm backend: ``shm <sock> <host>
+        <port>`` — shm-capable peers dial the doorbell socket, plain
+        TCP peers use the host/port half (mixed-backend interop)."""
         assert self._addr is not None, "NetBus.start() first"
+        if self._shm_path is not None:
+            return (f"shm {self._shm_path} "
+                    f"{self._addr[0]} {self._addr[1]}")
+        return f"{self._addr[0]} {self._addr[1]}"
+
+    def _publish(self, name: str) -> None:
         tmp = self._book_path(name) + f".tmp.{os.getpid()}"
         with open(tmp, "w") as f:
-            f.write(f"{self._addr[0]} {self._addr[1]}\n")
+            f.write(self._book_entry() + "\n")
         os.replace(tmp, self._book_path(name))  # atomic vs readers
 
     def register(self, name: str, dispatcher: Dispatcher) -> None:
@@ -123,8 +164,7 @@ class NetBus:
             return  # already removed (or never published)
         try:
             with open(claim) as f:
-                host, port = f.read().split()
-            ours = (host, int(port)) == self._addr
+                ours = f.read().strip() == self._book_entry()
         except (OSError, ValueError):
             ours = False
         if ours:
@@ -148,15 +188,28 @@ class NetBus:
             except OSError:
                 pass
 
-    def _resolve(self, name: str) -> tuple[str, int]:
+    def _resolve(self, name: str) -> tuple:
+        """-> (host, port): the TCP half of the book entry. Every
+        backend publishes one (the shm form carries host/port after
+        the doorbell socket), so this contract survives backend
+        selection — callers that dial raw TCP keep working."""
+        ep = self._resolve_ep(name)
+        return ep[-2], ep[-1]
+
+    def _resolve_ep(self, name: str) -> tuple:
+        """-> ("tcp", host, port) or ("shm", sock_path, host, port)."""
         addr = self._cache.get(name)
         if addr is not None:
             return addr
         try:
             with open(self._book_path(name)) as f:
-                host, port = f.read().split()
-            addr = (host, int(port))
-        except (OSError, ValueError):
+                tok = f.read().split()
+            if tok and tok[0] == "shm":
+                addr = ("shm", tok[1], tok[2], int(tok[3]))
+            else:
+                host, port = tok
+                addr = ("tcp", host, int(port))
+        except (OSError, ValueError, IndexError):
             raise SendError(f"no such entity {name!r}") from None
         self._cache[name] = addr
         return addr
@@ -185,21 +238,28 @@ class NetBus:
             self._tasks.add(task)
             task.add_done_callback(self._tasks.discard)
             return
-        addr = self._resolve(dst)
-        node = f"@{addr[0]}:{addr[1]}"
-        self._tcp.addrbook[node] = addr
+        addr = self._resolve_ep(dst)
         try:
-            await self._tcp.send(node, env)
+            await self._send_addr(addr, env)
         except SendError:
             self._cache.pop(dst, None)  # stale book/port: re-resolve once
-            addr = self._resolve(dst)
-            node = f"@{addr[0]}:{addr[1]}"
-            self._tcp.addrbook[node] = addr
+            addr = self._resolve_ep(dst)
             try:
-                await self._tcp.send(node, env)
+                await self._send_addr(addr, env)
             except SendError:
                 self._cache.pop(dst, None)
                 raise
+
+    async def _send_addr(self, addr: tuple, env) -> None:
+        """Route one envelope by parsed book address: the shm doorbell
+        socket when BOTH sides speak shm, the TCP half otherwise."""
+        if addr[0] == "shm" and self._shm is not None:
+            await self._shm.send(addr[1], env)
+            return
+        host, port = addr[-2:]
+        node = f"@{host}:{port}"
+        self._tcp.addrbook[node] = (host, port)
+        await self._tcp.send(node, env)
 
     async def _dispatch(self, _node_src: str, env) -> None:
         if not isinstance(env, MEnvelope):

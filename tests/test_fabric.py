@@ -1,0 +1,355 @@
+"""Serving-fabric tier: the shared-memory ring messenger, mergeable
+latency histograms, and ProcCluster as the measured topology.
+
+What this file proves (ISSUE 20):
+- ShmRing unit semantics: wrap-around slot reuse, full-ring
+  backpressure, and epoch-tagged descriptor reclamation after peer
+  death (a zombie's late release must be a no-op).
+- ShmMessenger honors NetFaultPolicy identically to LocalBus/TCP —
+  drop/delay/dup consult the SAME seeded plan() stream, so thrash
+  schedules stay deterministic per backend.
+- Histogram merging is exact where averaging per-worker percentiles
+  is wrong (the satellite-1 fix).
+- A seeded thrash over a ProcCluster of real daemon processes
+  converges byte-exact on BOTH messenger backends, and one EC
+  write/read cycle is byte-identical across localbus, tcp, and shm.
+"""
+import asyncio
+import importlib.util
+import os
+from pathlib import Path
+
+import pytest
+
+from ceph_tpu.cluster import messages as M
+from ceph_tpu.cluster.faults import NetFaultPolicy
+from ceph_tpu.msg.shmring import ShmMessenger, ShmRing
+from ceph_tpu.utils.lathist import LatHist
+
+_REPO = Path(__file__).resolve().parents[1]
+
+
+def run(coro, timeout=240):
+    return asyncio.run(asyncio.wait_for(coro, timeout))
+
+
+def _load_tool(name: str):
+    spec = importlib.util.spec_from_file_location(
+        f"ceph_tpu_{name}", _REPO / "tools" / f"{name}.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ------------------------------------------------------------- ring units
+
+
+def _ring_pair(tmp_path, slots=4, arena=1 << 16):
+    path = str(tmp_path / "ring")
+    prod = ShmRing(path, slots=slots, arena_bytes=arena, create=True)
+    cons = ShmRing(path, slots=slots, arena_bytes=arena, create=False)
+    return prod, cons
+
+
+def test_ring_wraparound_reuses_slots_and_extents(tmp_path):
+    prod, cons = _ring_pair(tmp_path, slots=4)
+    # 10x the slot count forces both index wrap-around and arena
+    # extent reuse; contents must survive the recycling byte-exact
+    for i in range(40):
+        payload = bytes([i & 0xFF]) * (100 + i)
+        assert prod.try_send([payload], mtype=7)
+        msgs = cons.recv_all()
+        assert len(msgs) == 1
+        assert bytes(msgs[0].view) == payload
+        assert msgs[0].mtype == 7
+        msgs[0].release()
+    assert prod.sends == 40
+    assert prod.backpressure_hits == 0
+    prod.close(unlink=True)
+    cons.close()
+
+
+def test_ring_full_backpressure_then_release_unblocks(tmp_path):
+    prod, cons = _ring_pair(tmp_path, slots=4)
+    for _ in range(4):
+        assert prod.try_send([b"x" * 64], mtype=1)
+    # ring full (nothing consumed): the producer must refuse, not
+    # overwrite
+    assert not prod.try_send([b"y" * 64], mtype=1)
+    assert prod.backpressure_hits == 1
+    msgs = cons.recv_all()
+    assert len(msgs) == 4
+    # consumed but NOT released: slots are still pinned
+    assert not prod.try_send([b"y" * 64], mtype=1)
+    for m_ in msgs:
+        m_.release()
+    assert prod.try_send([b"y" * 64], mtype=1)
+    got = cons.recv_all()
+    assert len(got) == 1 and bytes(got[0].view) == b"y" * 64
+    got[0].release()
+    prod.close(unlink=True)
+    cons.close()
+
+
+def test_ring_arena_exhaustion_is_backpressure(tmp_path):
+    prod, cons = _ring_pair(tmp_path, slots=64, arena=4096)
+    assert prod.try_send([b"a" * 3000], mtype=1)
+    # slots remain, arena does not: still backpressure, not a tear
+    assert not prod.try_send([b"b" * 3000], mtype=1)
+    msgs = cons.recv_all()
+    for m_ in msgs:
+        m_.release()
+    assert prod.try_send([b"b" * 3000], mtype=1)
+    for m_ in cons.recv_all():
+        m_.release()
+    prod.close(unlink=True)
+    cons.close()
+
+
+def test_ring_reclaim_after_peer_death_zombie_release_noop(tmp_path):
+    prod, cons = _ring_pair(tmp_path, slots=8)
+    for i in range(5):
+        assert prod.try_send([b"z" * 200], mtype=i)
+    zombies = cons.recv_all()
+    assert len(zombies) == 5
+    # consumer "dies" holding all 5 descriptors: reclaim force-frees
+    # them and bumps epochs
+    assert prod.reclaim_dead() == 5
+    assert prod.reclaimed_dead == 5
+    # the arena and every slot must be whole again
+    for _ in range(8):
+        assert prod.try_send([b"w" * 200], mtype=9)
+    # a zombie's late release lands on a bumped epoch: no-op (the
+    # slots it would flip are live again with NEW data)
+    for z in zombies:
+        z.release()
+    fresh = cons.recv_all()
+    assert len(fresh) == 8
+    assert all(bytes(m_.view) == b"w" * 200 for m_ in fresh)
+    for m_ in fresh:
+        m_.release()
+    # and the ring keeps working end-to-end after the whole episode
+    assert prod.try_send([b"ok"], mtype=1)
+    last = cons.recv_all()
+    assert len(last) == 1 and bytes(last[0].view) == b"ok"
+    last[0].release()
+    prod.close(unlink=True)
+    cons.close()
+
+
+# ------------------------------------------------------- messenger pair
+
+
+async def _mk_pair(tmp_path, faults_a=None):
+    inbox_a, inbox_b = [], []
+
+    async def da(src, msg):
+        inbox_a.append((src, msg))
+
+    async def db(src, msg):
+        inbox_b.append((src, msg))
+
+    a = ShmMessenger("a", da, faults=faults_a)
+    b = ShmMessenger("b", db)
+    # short /tmp paths: AF_UNIX socket paths cap at ~108 bytes
+    sa = await a.listen(f"/tmp/ctpu-t{os.getpid()}-a.sock")
+    sb = await b.listen(f"/tmp/ctpu-t{os.getpid()}-b.sock")
+    return a, b, sa, sb, inbox_a, inbox_b
+
+
+async def _drain(predicate, timeout=5.0):
+    deadline = asyncio.get_running_loop().time() + timeout
+    while not predicate():
+        if asyncio.get_running_loop().time() > deadline:
+            return False
+        await asyncio.sleep(0.01)
+    return True
+
+
+def test_shm_messenger_roundtrip_delivery(tmp_path):
+    async def body():
+        a, b, sa, sb, inbox_a, inbox_b = await _mk_pair(tmp_path)
+        try:
+            for i in range(50):
+                await a.send(sb, M.MPing(osd=i, epoch=i * 2))
+            assert await _drain(lambda: len(inbox_b) == 50)
+            src, last = inbox_b[-1]
+            assert src == "a"
+            assert last.osd == 49 and last.epoch == 98
+            await b.send(sa, M.MPing(osd=7, epoch=1))
+            assert await _drain(lambda: len(inbox_a) == 1)
+        finally:
+            await a.close()
+            await b.close()
+    run(body())
+
+
+def test_shm_messenger_fault_parity_with_policy_plan():
+    """drop/delay/dup inject through the SAME NetFaultPolicy.plan()
+    stream the LocalBus/TCP backends consult: a fresh policy with the
+    same seed replays plan() and predicts the shm delivery count
+    exactly (seed => schedule => verdict, per backend)."""
+    import random
+
+    async def body():
+        pol = NetFaultPolicy(random.Random(42))
+        pol.set_link("a", "*", drop=0.4, dup=0.3)
+        a, b, sa, sb, _ia, inbox_b = await _mk_pair(None, faults_a=pol)
+        try:
+            n = 60
+            for i in range(n):
+                await a.send(sb, M.MPing(osd=i, epoch=0))
+            # replay the identical plan stream to predict deliveries
+            ref = NetFaultPolicy(random.Random(42))
+            ref.set_link("a", "*", drop=0.4, dup=0.3)
+            expect = sum(len(p) for i in range(n)
+                         if (p := ref.plan("a", sb)) is not None)
+            assert await _drain(lambda: len(inbox_b) >= expect, 10)
+            await asyncio.sleep(0.05)  # no EXTRA copies either
+            assert len(inbox_b) == expect
+            assert 0 < expect < 2 * n  # faults actually engaged
+        finally:
+            await a.close()
+            await b.close()
+    run(body())
+
+
+def test_shm_messenger_delay_and_partition_parity():
+    import random
+
+    async def body():
+        pol = NetFaultPolicy(random.Random(3))
+        a, b, sa, sb, _ia, inbox_b = await _mk_pair(None, faults_a=pol)
+        try:
+            # partition: silent drop, counted like every backend
+            pol.partition({"a"}, {"*"})
+            await a.send(sb, M.MPing(osd=1, epoch=1))
+            await asyncio.sleep(0.1)
+            assert inbox_b == []
+            assert pol.counters.get("partition_drop", 0) == 1
+            pol.heal()
+            # delay: delivered, but not before the link delay elapses
+            pol.set_link("a", "*", delay=0.2)
+            loop = asyncio.get_running_loop()
+            t0 = loop.time()
+            await a.send(sb, M.MPing(osd=2, epoch=2))
+            assert await _drain(lambda: len(inbox_b) == 1, 5)
+            assert loop.time() - t0 >= 0.19
+        finally:
+            await a.close()
+            await b.close()
+    run(body())
+
+
+# -------------------------------------------------- histogram semantics
+
+
+def test_lathist_merge_exact_where_averaging_is_wrong():
+    # two reactor shards with very different tails: worker A all-fast,
+    # worker B all-slow. Pooled p99 is 100 ms; the old mean-of-
+    # per-worker-percentiles path reports ~50 ms. The merged histogram
+    # must land on the pooled answer.
+    a, b = LatHist(), LatHist()
+    for _ in range(1000):
+        a.note_ms(1.0)
+    for _ in range(100):
+        b.note_ms(100.0)
+    pooled = sorted([1.0] * 1000 + [100.0] * 100)
+    exact_p99 = pooled[int(0.99 * len(pooled))]
+    merged = LatHist.merged([a, b])
+    assert merged.count == 1100
+    assert abs(merged.percentile(0.99) - exact_p99) / exact_p99 < 0.02
+    averaged = (a.percentile(0.99) + b.percentile(0.99)) / 2
+    assert abs(averaged - exact_p99) / exact_p99 > 0.4  # the old bug
+
+
+def test_lathist_json_roundtrip_and_merge_associativity():
+    import json
+
+    hs = [LatHist() for _ in range(3)]
+    for i, h in enumerate(hs):
+        for j in range(50):
+            h.note_ms(0.5 * (i + 1) * (j + 1))
+    wire = [json.loads(json.dumps(h.to_json())) for h in hs]
+    back = [LatHist.from_json(d) for d in wire]
+    m1 = LatHist.merged(back)
+    m2 = LatHist.merged([back[2], back[0], back[1]])
+    assert m1.count == m2.count == 150
+    for p in (0.5, 0.99, 0.999):
+        assert m1.percentile(p) == m2.percentile(p)
+    assert m1.total_ms == pytest.approx(sum(h.total_ms for h in hs))
+
+
+# ------------------------------------------- process-tier acceptance
+
+
+@pytest.mark.parametrize("backend", ["tcp", "shm"])
+def test_proccluster_seeded_thrash_converges(backend, tmp_path):
+    """~5 s seeded thrash over REAL daemon processes on each messenger
+    backend: post-heal active+clean, byte-exact oracle, a clean
+    deep-scrub round, leak-free hedge ledger."""
+    thrash = _load_tool("thrash")
+    import argparse
+
+    args = argparse.Namespace(
+        seed=20260803, duration=4.0, osds=5, mons=1, k=3, m=2,
+        profile="rs", pg_num=8, objects=6, obj_size=24 << 10,
+        writers=2, settle=90.0, backend=backend,
+        objectstore="walstore", proc=True)
+    verdict = run(thrash._run_proc(args, max_unavail=2), timeout=300)
+    assert verdict["converged"], verdict
+    assert verdict["byte_exact"], verdict
+    assert verdict["scrub_inconsistent"] == 0, verdict
+    assert verdict["hedge_leak_free"], verdict["hedges"]
+    assert verdict["passed"], verdict
+
+
+def test_ec_write_read_byte_exact_across_backends(tmp_path):
+    """One EC write/read cycle, three messenger backends, one source
+    buffer: every byte identical (the A/B the zero-copy plane must
+    not break)."""
+    import numpy as np
+
+    from ceph_tpu.cluster.procstart import ProcCluster
+    from ceph_tpu.cluster.vstart import TestCluster
+    from ceph_tpu.placement.osdmap import Pool
+
+    data = np.random.default_rng(20).integers(
+        0, 256, 1 << 20, dtype=np.uint8).tobytes()
+    pool = dict(id=2, name="ab", size=6, min_size=4, pg_num=8,
+                crush_rule=1, type="erasure",
+                ec_profile={"plugin": "rs_tpu", "k": "4", "m": "2",
+                            "stripe_unit": "65536"})
+    results = {}
+
+    async def localbus():
+        c = TestCluster(n_osds=6)
+        await c.start()
+        try:
+            await c.client.create_pool(Pool(**pool))
+            await c.wait_active(30)
+            await c.client.write_full(2, "obj", data)
+            results["localbus"] = bytes(await c.client.read(2, "obj"))
+        finally:
+            await c.stop()
+
+    async def proc(backend):
+        d = tmp_path / backend
+        d.mkdir()
+        c = ProcCluster(str(d), n_osds=6, objectstore="memstore",
+                        backend=backend)
+        await c.start()
+        try:
+            await c.client.create_pool(Pool(**pool))
+            await c.wait_active(60)
+            await c.client.write_full(2, "obj", data)
+            results[backend] = bytes(await c.client.read(2, "obj"))
+        finally:
+            await c.stop()
+
+    run(localbus())
+    run(proc("tcp"))
+    run(proc("shm"))
+    assert results["localbus"] == data
+    assert results["tcp"] == data
+    assert results["shm"] == data

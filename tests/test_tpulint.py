@@ -948,6 +948,112 @@ def test_trace_bulk_crush_executor_shape_clean():
     assert out == []
 
 
+# ------------------------------------------------------- fabric-discipline
+
+
+def test_fabric_spawn_fork_fires():
+    out = lint(
+        """
+        import multiprocessing
+        import os
+
+        def shard_out():
+            pid = os.fork()
+            ctx = multiprocessing.get_context("fork")
+            return pid, ctx
+        """,
+        "tools/fixture.py", only=["fabric-spawn-discipline"])
+    assert any("os.fork" in m for m in msgs(out))
+    assert any("spawn-only" in m for m in msgs(out))
+
+
+def test_fabric_spawn_bare_mp_process_fires_popen_clean():
+    out = lint(
+        """
+        import multiprocessing
+        import subprocess
+        import sys
+
+        def workers(n):
+            bad = multiprocessing.Process(target=print)
+            good = subprocess.Popen([sys.executable, "-m", "x"])
+            ctx = multiprocessing.get_context("spawn")
+            return bad, good, ctx
+        """,
+        "ceph_tpu/cluster/fixture.py",
+        only=["fabric-spawn-discipline"])
+    assert len(out) == 1 and "fork start" in out[0].message
+
+
+def test_fabric_pipe_pickle_fires_on_pipe_surface():
+    out = lint(
+        """
+        import pickle
+
+        def ship(result, pipe):
+            pipe.write(pickle.dumps(result))
+
+        def recv(pipe):
+            return pickle.loads(pipe.read())
+        """,
+        "tools/swarm.py", only=["fabric-pipe-pickle"])
+    assert len(out) == 2
+    assert all("JSON histogram" in m for m in msgs(out))
+
+
+def test_fabric_pipe_pickle_scoped_and_json_clean():
+    # same calls OFF the pipe surfaces stay clean (store layers
+    # legitimately serialize); json on the surface is the idiom
+    out = lint(
+        """
+        import pickle
+
+        def snapshot(x):
+            return pickle.dumps(x)
+        """,
+        "ceph_tpu/store/fixture.py", only=["fabric-pipe-pickle"])
+    assert out == []
+    out = lint(
+        """
+        import json
+
+        def ship(result, pipe):
+            pipe.write(json.dumps(result).encode())
+        """,
+        "tools/swarm.py", only=["fabric-pipe-pickle"])
+    assert out == []
+
+
+def test_fabric_shm_release_missing_fires():
+    out = lint(
+        """
+        def drain(ring, sink):
+            for m in ring.recv_all():
+                sink.append(bytes(m.view))
+        """,
+        "ceph_tpu/msg/fixture.py", only=["fabric-shm-release"])
+    assert len(out) == 1
+    assert "release()" in out[0].message
+
+
+def test_fabric_shm_release_in_finally_clean():
+    out = lint(
+        """
+        def drain(ring, sink):
+            for m in ring.recv_all():
+                try:
+                    sink.append(bytes(m.view))
+                finally:
+                    m.release()
+
+        def reap(ring):
+            ring.recv_all()
+            return ring.reclaim_dead()
+        """,
+        "ceph_tpu/msg/fixture.py", only=["fabric-shm-release"])
+    assert out == []
+
+
 # ------------------------------------------------------------ repo gate
 
 

@@ -38,16 +38,31 @@ Modes:
   equivalent): pure memo+host placement, so the batched resolver's win
   is attributable.
 
+Sharded fabric mode (``run_fabric`` / ``--fabric``): offered load
+comes from N REACTOR PROCESSES, each owning a disjoint client slice
+with its own event loop — the GIL stops bounding offered load at one
+process's ceiling.  Workers report per-shape latency HISTOGRAMS
+(utils/lathist.py) over a JSON-line results pipe; the parent merges
+histograms and reads exact p50/p99/p999 off the merged counts.
+Percentiles are NEVER averaged across workers, and nothing pickled
+crosses the pipe.  Backends: ``local`` (each worker boots its own
+in-process cluster — the sharded-everything upper bound), ``tcp`` /
+``shm`` (workers dial a shared ProcCluster of real daemon processes
+over the chosen messenger backend).
+
 CLI:
     python tools/swarm.py --clients 2000 --duration 8
     python tools/swarm.py --qos --duration 6
     python tools/swarm.py --thrash-secs 5 --clients 500
+    python tools/swarm.py --fabric --backend shm --workers 4
 """
 from __future__ import annotations
 
 import argparse
 import asyncio
 import json
+import os
+import subprocess
 import sys
 import time
 from pathlib import Path
@@ -56,6 +71,8 @@ import numpy as np
 
 _REPO = Path(__file__).resolve().parents[1]
 sys.path.insert(0, str(_REPO))
+
+from ceph_tpu.utils.lathist import LatHist  # noqa: E402
 
 #: pool ids (outside the test-suite's habitual 1/2)
 POOL_SMALL = 21   # replicated: put4k/get4k/omap
@@ -66,32 +83,33 @@ POOL_LAT = 23     # replicated: the latency tenant's private pool
 DEFAULT_MIX = {"put4k": 0.45, "get4k": 0.40, "omap": 0.10,
                "put4m": 0.05}
 
-
-def _pct(sorted_ms: list, p: float) -> float:
-    if not sorted_ms:
-        return 0.0
-    return round(sorted_ms[min(len(sorted_ms) - 1,
-                               int(p * len(sorted_ms)))], 2)
+#: fabric results-pipe line marker (one JSON line per worker; the
+#: parent takes the LAST marked line so stray daemon chatter on the
+#: same fd never corrupts the protocol)
+_FABRIC_TAG = "CTPU_FABRIC1 "
 
 
-def _shape_report(lat_s: list, data_bytes: int, dt: float) -> dict:
-    ms = sorted(x * 1e3 for x in lat_s)
+def _shape_report(hist: LatHist, data_bytes: int, dt: float) -> dict:
     return {
-        "ops": len(ms),
-        "ops_s": round(len(ms) / dt, 1) if dt else 0.0,
+        "ops": hist.count,
+        "ops_s": round(hist.count / dt, 1) if dt else 0.0,
         "mib_s": round(data_bytes / dt / 2**20, 2) if dt else 0.0,
-        "p50_ms": _pct(ms, 0.50),
-        "p99_ms": _pct(ms, 0.99),
-        "p999_ms": _pct(ms, 0.999),
+        "p50_ms": round(hist.percentile(0.50), 2),
+        "p99_ms": round(hist.percentile(0.99), 2),
+        "p999_ms": round(hist.percentile(0.999), 2),
     }
 
 
 class _Recorder:
     """Per-shape latency/byte/miss ledger, fed by completion
-    callbacks on the loop."""
+    callbacks on the loop.  Latencies land in mergeable log-bucket
+    histograms (utils/lathist.py), never raw sample lists: one
+    recorder per REACTOR PROCESS, and the fabric parent merges
+    bucket counts — merging percentiles would be wrong the moment
+    there is a second source of load."""
 
     def __init__(self) -> None:
-        self.lat: dict[str, list] = {}
+        self.hist: dict[str, LatHist] = {}
         self.bytes: dict[str, int] = {}
         self.errors: dict[str, int] = {}
         self.get_misses = 0
@@ -105,7 +123,10 @@ class _Recorder:
             else:
                 self.errors[shape] = self.errors.get(shape, 0) + 1
                 return
-        self.lat.setdefault(shape, []).append(dt)
+        h = self.hist.get(shape)
+        if h is None:
+            h = self.hist[shape] = LatHist()
+        h.note_s(dt)
         self.bytes[shape] = self.bytes.get(shape, 0) + nbytes
 
 
@@ -419,7 +440,8 @@ async def run_swarm(*, clients: int = 2000, duration: float = 8.0,
                 for w in window_stats]
 
     shapes_out = {
-        s: _shape_report(rec.lat.get(s, []), rec.bytes.get(s, 0), dt)
+        s: _shape_report(rec.hist.get(s) or LatHist(),
+                         rec.bytes.get(s, 0), dt)
         for s in mix
     }
     active = [v for t, v in samples if t <= t_end]
@@ -429,7 +451,7 @@ async def run_swarm(*, clients: int = 2000, duration: float = 8.0,
     sustained = round(float(np.mean(mid)), 1) if mid else 0.0
     peak = max((v for _t, v in samples), default=0)
     total_bytes = sum(rec.bytes.values())
-    total_ops = sum(len(v) for v in rec.lat.values())
+    total_ops = sum(h.count for h in rec.hist.values())
 
     out = {
         "clients": clients,
@@ -456,7 +478,7 @@ async def run_swarm(*, clients: int = 2000, duration: float = 8.0,
         "osd_counters": osd_tot,
     }
     if qos:
-        lat_ms = _shape_report(lat_rec.lat.get("lat4k", []),
+        lat_ms = _shape_report(lat_rec.hist.get("lat4k") or LatHist(),
                                lat_rec.bytes.get("lat4k", 0), dt)
         bulk_ref = shapes_out.get("put4k", {})
         qos_out.update({
@@ -471,6 +493,338 @@ async def run_swarm(*, clients: int = 2000, duration: float = 8.0,
     for cl in swarm_clients:
         await cl.close()
     await c.stop()
+    return out
+
+
+# --------------------------------------------------------------- fabric
+#
+# Sharded reactors: the parent never drives load itself — it spawns N
+# worker PROCESSES (fresh interpreters via Popen: spawn semantics, so
+# no fork ever follows a JAX runtime init), coordinates a file-based
+# start barrier, and merges the per-shape histograms each worker ships
+# back as one JSON line on stdout.
+
+
+def _fabric_client_conf(window: int):
+    from ceph_tpu.utils import config as cfg
+
+    conf = cfg.proxy()
+    conf.set("client_max_inflight", window)
+    conf.set("client_backoff_max", 30.0)
+    conf.set("client_placement_batch_min", 8)
+    return conf
+
+
+async def _fabric_worker(cfg_d: dict) -> dict:
+    """One reactor shard: own event loop, disjoint client slice,
+    private recorder.  Returns the JSON-safe result payload (histogram
+    bucket dicts — never pickles, never raw sample lists)."""
+    import resource
+
+    w = int(cfg_d["worker"])
+    seed = int(cfg_d["seed"])
+    mix = dict(cfg_d["mix"])
+    duration = float(cfg_d["duration"])
+    depth = int(cfg_d.get("depth", 8))
+    window = int(cfg_d.get("window", 1024))
+    actors = int(cfg_d["clients"])
+    n_objects = int(cfg_d.get("n_objects", 100_000))
+    zipf_s = float(cfg_d.get("zipf_s", 1.1))
+    barrier = Path(cfg_d["barrier"])
+
+    from ceph_tpu.cluster.client import RadosClient
+    from ceph_tpu.placement.osdmap import Pool
+
+    cluster = None
+    bus = None
+    if cfg_d["mode"] == "local":
+        # sharded-everything arm: this worker owns a PRIVATE
+        # in-process cluster — the upper bound where nothing is shared
+        from ceph_tpu.cluster.vstart import TestCluster
+
+        cluster = TestCluster(n_osds=int(cfg_d.get("n_osds", 6)),
+                              osd_conf=dict(cfg_d.get("osd_conf", {})))
+        await cluster.start()
+        swarm_clients = [RadosClient(
+            cluster.bus, name=f"fw{w}.{i}", op_timeout=300.0,
+            conf=_fabric_client_conf(window))
+            for i in range(int(cfg_d.get("n_rados_clients", 2)))]
+        for cl in swarm_clients:
+            await cl.connect()
+        await cluster.client.create_pool(Pool(
+            id=POOL_SMALL, name="fab-small", size=3, min_size=2,
+            pg_num=32, crush_rule=0))
+        await cluster.client.create_pool(Pool(
+            id=POOL_BIG, name="fab-big", size=6, min_size=4,
+            pg_num=16, crush_rule=1, type="erasure",
+            ec_profile={"plugin": "rs_tpu", "k": "4", "m": "2",
+                        "stripe_unit": "65536"}))
+        await cluster.wait_active(60)
+    else:
+        # shared ProcCluster: dial the daemons' book over the chosen
+        # messenger backend (tcp or shm)
+        from ceph_tpu.msg.netbus import NetBus
+
+        bus = NetBus(cfg_d["book"], backend=cfg_d["backend"])
+        await bus.start()
+        swarm_clients = [RadosClient(
+            bus, name=f"fw{w}.{i}", op_timeout=300.0,
+            conf=_fabric_client_conf(window))
+            for i in range(int(cfg_d.get("n_rados_clients", 2)))]
+        for cl in swarm_clients:
+            await cl.connect()
+
+    rng = np.random.default_rng(seed)
+    payload4k = rng.integers(0, 256, 4096, dtype=np.uint8).tobytes()
+    payload4m = rng.integers(0, 256, 4 << 20, dtype=np.uint8).tobytes()
+    # warm outside the measured window (compiles, maps, pool waits)
+    await swarm_clients[0].write_full(POOL_SMALL, f"warm-{w}",
+                                      payload4k)
+    if mix.get("put4m"):
+        await swarm_clients[0].write_full(POOL_BIG, f"warm-{w}",
+                                          payload4m)
+
+    # barrier: ready -> wait for go (simultaneous offered load across
+    # every shard; a shard that starts early would measure an idle
+    # cluster)
+    (barrier / f"w{w}.ready").write_text(str(os.getpid()))
+    go = barrier / "go"
+    deadline = time.monotonic() + 120
+    while not go.exists():
+        if time.monotonic() > deadline:
+            raise TimeoutError("fabric start barrier never opened")
+        await asyncio.sleep(0.02)
+
+    rec = _Recorder()
+    loop = asyncio.get_running_loop()
+    big_sem = asyncio.Semaphore(8)
+    t_end = loop.time() + duration
+    ru0 = resource.getrusage(resource.RUSAGE_SELF)
+    t0 = time.perf_counter()
+    tasks = [loop.create_task(_actor(
+        (w << 16) | a, rec, swarm_clients, big_sem, mix,
+        seed + w, n_objects, zipf_s, payload4k, payload4m, t_end,
+        depth)) for a in range(actors)]
+    await asyncio.gather(*tasks)
+    for cl in swarm_clients:
+        await cl.writes_wait()
+    dt = time.perf_counter() - t0
+    ru1 = resource.getrusage(resource.RUSAGE_SELF)
+    cpu_s = (ru1.ru_utime - ru0.ru_utime) + (ru1.ru_stime
+                                             - ru0.ru_stime)
+
+    out = {
+        "worker": w,
+        "dt": round(dt, 3),
+        "cpu_s": round(cpu_s, 3),
+        "ops": sum(h.count for h in rec.hist.values()),
+        "objects": len(rec.objects),
+        "get_misses": rec.get_misses,
+        "errors": rec.errors,
+        "shapes": {
+            s: {"hist": h.to_json(), "bytes": rec.bytes.get(s, 0)}
+            for s, h in rec.hist.items()
+        },
+    }
+    for cl in swarm_clients:
+        await cl.close()
+    if cluster is not None:
+        await cluster.stop()
+    if bus is not None:
+        await bus.close()
+    return out
+
+
+def _fabric_worker_main(cfg_json: str) -> int:
+    out = asyncio.run(_fabric_worker(json.loads(cfg_json)))
+    sys.stdout.write(_FABRIC_TAG + json.dumps(out) + "\n")
+    sys.stdout.flush()
+    return 0
+
+
+async def run_fabric(*, backend: str = "tcp", n_workers: int = 2,
+                     clients_per_worker: int = 200,
+                     duration: float = 4.0, seed: int = 1,
+                     n_osds: int = 6, mix: dict | None = None,
+                     data_dir: str | None = None, window: int = 1024,
+                     depth: int = 8, n_objects: int = 100_000,
+                     zipf_s: float = 1.1,
+                     osd_conf: dict | None = None) -> dict:
+    """Sharded fabric run: N reactor processes against one topology.
+
+    ``backend="local"``: every worker boots a private in-process
+    cluster (nothing shared — the pure sharding upper bound).
+    ``"tcp"`` / ``"shm"``: ONE shared ProcCluster of real daemon
+    processes; workers dial its book over the chosen messenger.
+    Returns the merged verdict: per-shape histograms merged bucket-
+    wise (exact percentiles), plus the cpu-seconds ledger split into
+    worker and daemon halves.
+    """
+    import shutil
+    import tempfile
+
+    if backend not in ("local", "tcp", "shm"):
+        raise ValueError(f"unknown fabric backend {backend!r}")
+    mix = dict(mix or DEFAULT_MIX)
+    osd_conf = dict(osd_conf or {
+        "osd_ec_batch_window": 0.01,
+        "osd_ec_batch_target_stripes": 48,
+        "osd_op_concurrency": 32,
+        "osd_client_message_size_cap": 256 << 20,
+    })
+    own_dir = data_dir is None
+    data_dir = data_dir or tempfile.mkdtemp(prefix="ctpu-fabric-")
+    barrier = Path(data_dir) / "barrier"
+    shutil.rmtree(barrier, ignore_errors=True)
+    barrier.mkdir(parents=True)
+
+    cluster = None
+    cpu_daemons0 = 0.0
+    if backend != "local":
+        from ceph_tpu.cluster.procstart import ProcCluster
+        from ceph_tpu.placement.osdmap import Pool
+
+        cluster = ProcCluster(data_dir, n_osds=n_osds,
+                              objectstore="memstore", backend=backend,
+                              osd_conf=osd_conf)
+        await cluster.start()
+        await cluster.client.create_pool(Pool(
+            id=POOL_SMALL, name="fab-small", size=3, min_size=2,
+            pg_num=32, crush_rule=0))
+        await cluster.client.create_pool(Pool(
+            id=POOL_BIG, name="fab-big", size=6, min_size=4,
+            pg_num=16, crush_rule=1, type="erasure",
+            ec_profile={"plugin": "rs_tpu", "k": "4", "m": "2",
+                        "stripe_unit": "65536"}))
+        await cluster.wait_active(60)
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(_REPO) + os.pathsep + env.get(
+        "PYTHONPATH", "")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    procs: list[subprocess.Popen] = []
+    logs = []
+    try:
+        for w_i in range(n_workers):
+            cfg_d = {
+                "mode": "local" if backend == "local" else "proc",
+                "backend": backend,
+                "book": (cluster.book if cluster is not None
+                         else ""),
+                "barrier": str(barrier),
+                "worker": w_i,
+                "n_workers": n_workers,
+                "clients": clients_per_worker,
+                "duration": duration,
+                "seed": seed,
+                "mix": mix,
+                "window": window,
+                "depth": depth,
+                "n_objects": n_objects,
+                "zipf_s": zipf_s,
+                "n_osds": n_osds,
+                "osd_conf": osd_conf,
+            }
+            log = open(Path(data_dir) / f"worker.{w_i}.err", "wb")
+            logs.append(log)
+            procs.append(subprocess.Popen(
+                [sys.executable, str(_REPO / "tools" / "swarm.py"),
+                 "--fabric-worker", json.dumps(cfg_d)],
+                stdout=subprocess.PIPE, stderr=log, env=env))
+
+        # barrier: all shards ready -> open the gate together
+        deadline = time.monotonic() + 120
+        while True:
+            ready = sum((barrier / f"w{i}.ready").exists()
+                        for i in range(n_workers))
+            if ready == n_workers:
+                break
+            for i, p in enumerate(procs):
+                if p.poll() is not None:
+                    raise RuntimeError(
+                        f"fabric worker {i} died before the barrier "
+                        f"(rc={p.returncode}, see "
+                        f"{data_dir}/worker.{i}.err)")
+            if time.monotonic() > deadline:
+                raise TimeoutError("fabric workers never all readied")
+            await asyncio.sleep(0.05)
+        if cluster is not None:
+            cpu_daemons0 = cluster.cpu_seconds()
+        (barrier / "go").write_text("go")
+
+        # results pipe: one tagged JSON line per worker
+        loop = asyncio.get_running_loop()
+        outs = []
+        for i, p in enumerate(procs):
+            raw = await asyncio.wait_for(
+                loop.run_in_executor(None, p.communicate),
+                duration + 600)
+            lines = [ln for ln in raw[0].decode().splitlines()
+                     if ln.startswith(_FABRIC_TAG)]
+            if p.returncode != 0 or not lines:
+                raise RuntimeError(
+                    f"fabric worker {i} failed (rc={p.returncode}, "
+                    f"see {data_dir}/worker.{i}.err)")
+            outs.append(json.loads(lines[-1][len(_FABRIC_TAG):]))
+        cpu_daemons = (cluster.cpu_seconds() - cpu_daemons0
+                       if cluster is not None else 0.0)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+        for log in logs:
+            log.close()
+        if cluster is not None:
+            await cluster.stop()
+
+    # merge: histograms bucket-wise, byte/err counters by sum; the
+    # wall clock of the run is the SLOWEST shard's window (offered
+    # load overlapped for at least that long)
+    dt = max(o["dt"] for o in outs)
+    hists: dict[str, LatHist] = {}
+    bytes_: dict[str, int] = {}
+    errors: dict[str, int] = {}
+    for o in outs:
+        for s, d in o["shapes"].items():
+            hists.setdefault(s, LatHist()).merge(
+                LatHist.from_json(d["hist"]))
+            bytes_[s] = bytes_.get(s, 0) + int(d["bytes"])
+        for s, n in o.get("errors", {}).items():
+            errors[s] = errors.get(s, 0) + int(n)
+    shapes_out = {s: _shape_report(hists[s], bytes_.get(s, 0), dt)
+                  for s in hists}
+    cpu_workers = sum(o["cpu_s"] for o in outs)
+    write_bytes = sum(bytes_.get(s, 0) for s in bytes_
+                      if s.startswith("put"))
+    total_bytes = sum(bytes_.values())
+    write_mib = write_bytes / 2**20
+    cpu_total = cpu_workers + cpu_daemons
+    out = {
+        "backend": backend,
+        "workers": n_workers,
+        "clients_per_worker": clients_per_worker,
+        "host_cpus": os.cpu_count(),
+        "duration_s": round(dt, 2),
+        "seed": seed,
+        "n_osds": n_osds,
+        "ops": sum(o["ops"] for o in outs),
+        "ops_s": round(sum(o["ops"] for o in outs) / dt, 1)
+        if dt else 0.0,
+        "mib_s": round(total_bytes / dt / 2**20, 2) if dt else 0.0,
+        "write_mib_s": round(write_mib / dt, 2) if dt else 0.0,
+        "get_p99_ms": shapes_out.get("get4k", {}).get("p99_ms", 0.0),
+        "cpu_s_workers": round(cpu_workers, 2),
+        "cpu_s_daemons": round(cpu_daemons, 2),
+        "cpu_s_per_mib": (round(cpu_total / write_mib, 4)
+                          if write_mib else 0.0),
+        "get_misses": sum(o.get("get_misses", 0) for o in outs),
+        "op_errors": errors,
+        "distinct_objects_touched": sum(o["objects"] for o in outs),
+        "shapes": shapes_out,
+    }
+    if own_dir:
+        shutil.rmtree(data_dir, ignore_errors=True)
     return out
 
 
@@ -492,7 +846,27 @@ def main(argv: list[str] | None = None) -> int:
                     help="mClock tenant-isolation mode")
     ap.add_argument("--no-placement-batch", action="store_true",
                     help="A/B arm: disable the batched resolver")
+    ap.add_argument("--fabric", action="store_true",
+                    help="sharded fabric mode: N reactor processes")
+    ap.add_argument("--workers", type=int, default=2,
+                    help="fabric: reactor process count")
+    ap.add_argument("--backend", default="tcp",
+                    choices=["local", "tcp", "shm"],
+                    help="fabric: topology/messenger backend")
+    ap.add_argument("--fabric-worker", metavar="CFGJSON",
+                    help=argparse.SUPPRESS)  # internal child entry
     args = ap.parse_args(argv)
+    if args.fabric_worker:
+        return _fabric_worker_main(args.fabric_worker)
+    if args.fabric:
+        out = asyncio.run(run_fabric(
+            backend=args.backend, n_workers=args.workers,
+            clients_per_worker=max(1, args.clients // args.workers),
+            duration=args.duration, seed=args.seed, n_osds=args.osds,
+            window=args.window, depth=args.depth,
+            n_objects=args.objects, zipf_s=args.zipf))
+        print(json.dumps(out, indent=1, sort_keys=True))
+        return 0
     out = asyncio.run(run_swarm(
         clients=args.clients, duration=args.duration, seed=args.seed,
         n_osds=args.osds, window=args.window,
